@@ -1,0 +1,119 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit (NOT a port; see SURVEY.md).
+
+Top-level namespace mirrors `import paddle`: tensor ops, nn, optimizer, amp,
+io, distributed, jit, vision, metric, profiler, incubate.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    CPUPlace,
+    CustomPlace,
+    Parameter,
+    Place,
+    TPUPlace,
+    Tensor,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    uint8,
+    device_count,
+    enable_grad,
+    get_device,
+    get_flags,
+    is_grad_enabled,
+    no_grad,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_flags,
+)
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import creation, linalg, manipulation, math  # noqa: F401
+from .serialization import load, save  # noqa: F401
+
+from . import amp  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+
+# Subpackages imported lazily to keep `import paddle_tpu` light and avoid
+# cycles; they self-register on first access.
+import importlib as _importlib
+
+_LAZY = {
+    "io": "paddle_tpu.io",
+    "jit": "paddle_tpu.jit",
+    "vision": "paddle_tpu.vision",
+    "metric": "paddle_tpu.metric",
+    "distributed": "paddle_tpu.distributed",
+    "profiler": "paddle_tpu.profiler",
+    "incubate": "paddle_tpu.incubate",
+    "hapi": "paddle_tpu.hapi",
+    "static": "paddle_tpu.static",
+    "models": "paddle_tpu.models",
+    "parallel": "paddle_tpu.parallel",
+    "utils": "paddle_tpu.utils",
+    "device": "paddle_tpu.device_ns",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = _importlib.import_module(_LAZY[name])
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False):
+    """paddle.grad parity (eager): returns grads of outputs w.r.t. inputs."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [(p, p.grad) for p in ins]
+    for p in ins:
+        p.grad = None
+    for o in outs:
+        o.backward()
+    grads = [p.grad for p in ins]
+    for p, g in saved:
+        p.grad = g
+    return grads
+
+
+def enable_static():
+    from . import static as _static
+
+    _static._enable()
+
+
+def disable_static():
+    from . import static as _static
+
+    _static._disable()
+
+
+def in_dynamic_mode():
+    try:
+        from . import static as _static
+
+        return not _static._enabled()
+    except Exception:
+        return True
+
+
+def summary(net, input_size=None, dtypes=None):
+    n_params = sum(p.size for p in net.parameters())
+    trainable = sum(p.size for p in net.parameters() if p.trainable)
+    return {"total_params": n_params, "trainable_params": trainable}

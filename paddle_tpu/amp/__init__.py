@@ -1,0 +1,192 @@
+"""AMP: autocast + GradScaler (reference: python/paddle/amp/auto_cast.py,
+grad_scaler.py; C++ hooks paddle/fluid/eager/amp_utils.h).
+
+TPU is bf16-first: O1 casts whitelist ops (matmul/conv) to the low-precision
+dtype, O2 casts everything outside the blacklist. bf16 needs no loss scaling,
+so GradScaler with bf16 degrades to an API-compatible no-op (scale=1, never
+skips); with float16 it performs real dynamic loss scaling.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes
+from ..framework.tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "is_auto_cast_enabled", "get_amp_dtype"]
+
+_tls = threading.local()
+
+# Ops whose inputs are cast down under O1 (matmul-class: MXU-bound).
+WHITE_LIST = {"matmul", "linear", "conv2d", "conv1d", "conv3d", "einsum", "attention"}
+# Ops kept in fp32 even under O2 (numerics-sensitive).
+BLACK_LIST = {"softmax", "log_softmax", "layer_norm", "batch_norm", "group_norm",
+              "cross_entropy", "mean", "sum", "exp", "log", "rms_norm", "logsumexp"}
+
+
+def _state():
+    if not hasattr(_tls, "amp"):
+        _tls.amp = {"enabled": False, "dtype": np.dtype(dtypes.bfloat16), "level": "O1",
+                    "custom_white": set(), "custom_black": set()}
+    return _tls.amp
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state()["enabled"]
+
+
+def get_amp_dtype():
+    return _state()["dtype"]
+
+
+def get_amp_level():
+    return _state()["level"]
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1", dtype="bfloat16"):
+    st = _state()
+    prev = dict(st)
+    st["enabled"] = enable
+    st["dtype"] = dtypes.convert_dtype(dtype)
+    st["level"] = level
+    st["custom_white"] = set(custom_white_list or ())
+    st["custom_black"] = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        st.update(prev)
+
+
+amp_guard = auto_cast
+
+
+def amp_cast(op_name, *tensors):
+    """Called by functional ops: cast inputs per the active AMP policy."""
+    st = _state()
+    if not st["enabled"]:
+        return tensors
+    black = (BLACK_LIST | st["custom_black"]) - st["custom_white"]
+    if op_name in black:
+        # promote to fp32 for blacklist ops
+        return tuple(
+            t.astype("float32") if isinstance(t, Tensor) and _low(t.dtype) else t for t in tensors
+        )
+    white = WHITE_LIST | st["custom_white"]
+    if st["level"] == "O2" or op_name in white:
+        dt = st["dtype"]
+        return tuple(
+            t.astype(dt) if isinstance(t, Tensor) and _castable(t.dtype, dt) else t
+            for t in tensors
+        )
+    return tensors
+
+
+def _low(dt):
+    return np.dtype(dt) in (np.dtype(dtypes.float16), np.dtype(dtypes.bfloat16))
+
+
+def _castable(dt, target):
+    return dtypes.is_floating_point(dt) and np.dtype(dt) != np.dtype(target)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16", master_weight=None):
+    """paddle.amp.decorate parity: O2 casts model params to the AMP dtype.
+
+    Master weights: optimizers here keep fp32 master copies whenever a param
+    is low-precision and ``multi_precision`` is on (default for AdamW), so
+    decorate only needs to cast the params."""
+    single_model = not isinstance(models, (list, tuple))
+    ms = [models] if single_model else list(models)
+    if level == "O2":
+        for m in ms:
+            m.to(dtype=dtype)
+    if optimizers is None:
+        return models if single_model else ms
+    return (models if single_model else ms), optimizers
+
+
+class GradScaler:
+    """Dynamic loss scaling (reference: python/paddle/amp/grad_scaler.py).
+
+    With bf16 (TPU default) scaling is unnecessary: ``enable=False`` keeps the
+    full API while multiplying by 1."""
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        inv = 1.0 / self._scale
+        found = False
+        for p in optimizer._parameter_list():
+            if p.grad is not None:
+                g = p.grad._data * inv
+                found = bool(found or not bool(jnp.all(jnp.isfinite(g))))
+                p.grad._data = g
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def is_enable(self):
+        return self._enable
+
+    def get_loss_scaling(self):
+        return Tensor(self._scale)
+
+    def state_dict(self):
+        return {"scale": self._scale, "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, st):
+        self._scale = st["scale"]
+        self._good_steps = st["good_steps"]
+        self._bad_steps = st["bad_steps"]

@@ -1,0 +1,58 @@
+"""Framework core: Tensor, autograd, dtype, device, RNG, flags."""
+from . import dtype as dtypes
+from .dtype import (
+    bool_,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+    convert_dtype,
+    get_default_dtype,
+    set_default_dtype,
+)
+from .tensor import (
+    Parameter,
+    Tensor,
+    apply_op,
+    enable_grad,
+    is_grad_enabled,
+    no_grad,
+    pause_tape,
+    tape_paused,
+    to_tensor,
+)
+from .random import seed, get_rng_state, set_rng_state
+from .device import (
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    set_device,
+)
+from .flags import define_flag, get_flags, set_flags
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "apply_op",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "pause_tape",
+    "tape_paused",
+    "to_tensor",
+    "seed",
+    "set_device",
+    "get_device",
+    "set_flags",
+    "get_flags",
+]

@@ -1,0 +1,85 @@
+"""Dtype registry: Paddle-style dtype names mapped onto JAX dtypes.
+
+Reference parity: paddle's dtype surface (paddle/phi/common/data_type.h and
+python/paddle/framework/dtype.py in the reference) exposes named dtypes and
+string aliases. On TPU the canonical compute dtype is bfloat16; float32 is
+the default parameter dtype (master-weight style), matching the reference's
+fp32-default with AMP-on-top model.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtypes under the hood).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_STR_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype-ish value (string, np/jnp dtype, None) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return np.dtype(_STR_TO_DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Canonical string name for a dtype ('float32', 'bfloat16', ...)."""
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    d = np.dtype(dtype)
+    return d in (np.dtype(t) for t in _FLOATING)
+
+
+# Default dtype handling (paddle.get_default_dtype/set_default_dtype parity).
+_default_dtype = np.dtype(np.float32)
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not is_floating_point(d):
+        raise TypeError("default dtype must be floating point")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,57 @@
+"""FLAGS registry (reference: gflags-style PHI_DEFINE_EXPORTED_* in
+paddle/phi/core/flags.cc; paddle.set_flags/get_flags API).
+
+A typed dict with env-var override (FLAGS_xxx) at first read. XLA-level knobs
+are deliberately passed through to XLA_FLAGS / LIBTPU_INIT_ARGS rather than
+being re-modeled here (SURVEY.md §5.6).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+_REGISTRY: Dict[str, Any] = {}
+_DEFINED: Dict[str, type] = {}
+
+
+def define_flag(name: str, default, help_str: str = ""):
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    env = os.environ.get(name)
+    value = default
+    if env is not None:
+        t = type(default)
+        if t is bool:
+            value = env.lower() in ("1", "true", "yes")
+        else:
+            value = t(env)
+    _REGISTRY[name] = value
+    _DEFINED[name] = type(default)
+    return value
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        _REGISTRY[k] = v
+
+
+def get_flags(names):
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    for k in names:
+        if not k.startswith("FLAGS_"):
+            k = "FLAGS_" + k
+        out[k] = _REGISTRY.get(k)
+    return out
+
+
+# Core flags (names mirror the reference where a concept carries over).
+define_flag("FLAGS_allocator_strategy", "xla_bfc", "allocator is XLA/PJRT's BFC; informational")
+define_flag("FLAGS_use_flash_attention", True, "route attention through the Pallas flash kernel")
+define_flag("FLAGS_flash_attn_block_q", 128, "flash attention q tile")
+define_flag("FLAGS_flash_attn_block_k", 128, "flash attention kv tile")
+define_flag("FLAGS_check_nan_inf", False, "enable debug nan checks in optimizer steps")
+define_flag("FLAGS_log_level", "INFO", "python log level")

@@ -1,0 +1,94 @@
+"""Global RNG management (reference: paddle.seed / generator state in
+paddle/phi/core/generator.cc; mp-rank RNG tracker parity lives in
+paddle_tpu.distributed.fleet.meta_parallel.random).
+
+JAX has no global generator; we keep a process-global base key plus a
+monotonically increasing counter. Eager ops split fresh subkeys; jitted code
+must thread keys explicitly (the layer library does so via the RNG tracker).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+_lock = threading.Lock()
+_state = {"seed": 0, "counter": 0, "key": jax.random.key(0)}
+
+
+def seed(s: int):
+    """Set the global seed (paddle.seed parity)."""
+    with _lock:
+        _state["seed"] = int(s)
+        _state["counter"] = 0
+        _state["key"] = jax.random.key(int(s))
+    return None
+
+
+def get_seed() -> int:
+    return _state["seed"]
+
+
+def next_key():
+    """Return a fresh PRNG key (eager use only — not jit-stable)."""
+    with _lock:
+        _state["counter"] += 1
+        return jax.random.fold_in(_state["key"], _state["counter"])
+
+
+def base_key():
+    """The base key for deterministic jit-side derivation via fold_in."""
+    return _state["key"]
+
+
+class _KeyCtx(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_key_ctx = _KeyCtx()
+
+
+class key_context:
+    """Context manager installing a base PRNG key for traced code.
+
+    The jitted training path enters ``key_context(fold_in(base, step))`` so
+    every dropout/random op inside the trace derives a deterministic,
+    site-unique key (fold_in of a per-trace call counter) — step-dependence
+    comes from the context key being a traced value. Mirrors the reference's
+    seed/offset philox bookkeeping in fused dropout kernels
+    (paddle/phi/kernels/fusion/gpu/fused_dropout_add_kernel.cu).
+    """
+
+    def __init__(self, key):
+        self.key = key
+
+    def __enter__(self):
+        _key_ctx.stack.append([self.key, 0])
+        return self
+
+    def __exit__(self, *exc):
+        _key_ctx.stack.pop()
+        return False
+
+
+def op_key():
+    """Key for one random op: context-derived when tracing, global otherwise."""
+    if _key_ctx.stack:
+        entry = _key_ctx.stack[-1]
+        entry[1] += 1
+        return jax.random.fold_in(entry[0], entry[1])
+    return next_key()
+
+
+def in_key_context() -> bool:
+    return bool(_key_ctx.stack)
+
+
+def get_rng_state():
+    return dict(_state)
+
+
+def set_rng_state(st):
+    with _lock:
+        _state.update(st)

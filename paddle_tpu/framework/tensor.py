@@ -1,0 +1,813 @@
+"""Tensor: eager (dygraph) facade over ``jax.Array`` with tape autograd.
+
+Design (SURVEY.md §7 "functional core, Paddle-shaped shell"):
+
+* A ``Tensor`` wraps one ``jax.Array`` (``._data``). All math is delegated to
+  jnp/lax, so every op runs through XLA — on TPU each eager op is an async
+  dispatch, and anything wrapped in ``jit`` (the perf path) traces straight
+  through this class because ``_data`` may hold a tracer.
+* Dygraph autograd re-provides the reference's eager GradNode engine
+  (reference: paddle/fluid/eager/backward.cc ``RunBackward``) as a *tape of
+  VJP closures*: every differentiable op captures ``jax.vjp`` at forward
+  time; ``Tensor.backward()`` walks nodes in reverse creation order and
+  accumulates cotangents. This costs one extra traced forward per op in
+  eager mode only — the jitted training path uses ``jax.grad`` directly and
+  never builds a tape (see paddle_tpu.jit.functional_call, which pauses it).
+* Gradient hooks (``register_hook``) mirror the reference's autograd hooks
+  (paddle/fluid/eager/grad_node_info.h) — they are what DataParallel overlap
+  and sharding stage2 build on in the reference (imperative/reducer.cc).
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "apply_op",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "pause_tape",
+    "tape_paused",
+    "to_tensor",
+]
+
+_tls = threading.local()
+
+
+def _grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def _paused() -> bool:
+    return getattr(_tls, "tape_paused", False)
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled() and not _paused()
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = getattr(_tls, "grad_enabled", True)
+    _tls.grad_enabled = False
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = getattr(_tls, "grad_enabled", True)
+    _tls.grad_enabled = True
+    try:
+        yield
+    finally:
+        _tls.grad_enabled = prev
+
+
+@contextlib.contextmanager
+def pause_tape():
+    """Disable tape recording while still letting jax-level AD flow.
+
+    Used by the functional/jit path: inside ``jax.grad`` the underlying jnp
+    calls carry derivatives natively, so taping would only double-trace.
+    """
+    prev = getattr(_tls, "tape_paused", False)
+    _tls.tape_paused = True
+    try:
+        yield
+    finally:
+        _tls.tape_paused = prev
+
+
+def tape_paused() -> bool:
+    return _paused()
+
+
+_node_seq = itertools.count()
+
+
+class _Node:
+    """One recorded differentiable op (the GradNode analogue)."""
+
+    __slots__ = ("seq", "vjp_fn", "inputs", "out_avals", "out_grads", "out_tensors")
+
+    def __init__(self, vjp_fn, inputs, out_avals):
+        self.seq = next(_node_seq)
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # tuple[Tensor] — primals we differentiated w.r.t.
+        self.out_avals = out_avals  # tuple[(shape, dtype)]
+        self.out_grads: list = [None] * len(out_avals)
+        self.out_tensors: list = [None] * len(out_avals)  # weakly informative; for hooks
+
+
+def _is_float(dt) -> bool:
+    return dtypes.is_floating_point(np.dtype(dt)) or np.dtype(dt) in (
+        np.dtype(np.complex64),
+        np.dtype(np.complex128),
+    )
+
+
+def apply_op(fn: Callable, *inputs, **kwargs):
+    """Run ``fn`` (a pure jax function of raw arrays) on mixed Tensor/array
+    inputs, recording a VJP node on the tape when gradients are required.
+
+    ``fn`` may return one array or a tuple of arrays. Non-Tensor inputs and
+    all kwargs are closed over as constants. Only floating-point tensors with
+    ``stop_gradient=False`` become differentiation primals.
+    """
+    arrays = [x._data if isinstance(x, Tensor) else x for x in inputs]
+    diff_idx = [
+        i
+        for i, x in enumerate(inputs)
+        if isinstance(x, Tensor) and not x.stop_gradient and _is_float(x.dtype)
+    ]
+    record = bool(diff_idx) and is_grad_enabled()
+
+    if not record:
+        outs = fn(*arrays, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        outs_t = tuple(Tensor._wrap(o, stop_gradient=True) for o in (outs if multi else (outs,)))
+        return outs_t if multi else outs_t[0]
+
+    def pure(*primals):
+        full = list(arrays)
+        for i, a in zip(diff_idx, primals):
+            full[i] = a
+        return fn(*full, **kwargs)
+
+    primals = tuple(arrays[i] for i in diff_idx)
+    outs, vjp_fn = jax.vjp(pure, *primals)
+    multi = isinstance(outs, (tuple, list))
+    outs_tuple = tuple(outs) if multi else (outs,)
+    node = _Node(
+        vjp_fn,
+        tuple(inputs[i] for i in diff_idx),
+        tuple((o.shape, o.dtype) for o in outs_tuple),
+    )
+    wrapped = []
+    for k, o in enumerate(outs_tuple):
+        t = Tensor._wrap(o, stop_gradient=not _is_float(o.dtype))
+        if not t.stop_gradient:
+            t._node = node
+            t._out_index = k
+            node.out_tensors[k] = t
+        wrapped.append(t)
+    return tuple(wrapped) if multi else wrapped[0]
+
+
+def _run_backward(root: "Tensor", grad):
+    if root._node is None:
+        # Leaf with requires-grad: gradient of itself is the seed.
+        if not root.stop_gradient:
+            root._accumulate_grad(grad)
+        return
+    root._node.out_grads[root._out_index] = _add_maybe(
+        root._node.out_grads[root._out_index], grad
+    )
+
+    # Collect reachable nodes, process in reverse creation order (a valid
+    # reverse-topological order because an op's inputs predate it).
+    seen = {}
+    stack = [root._node]
+    while stack:
+        n = stack.pop()
+        if n.seq in seen:
+            continue
+        seen[n.seq] = n
+        for t in n.inputs:
+            if t._node is not None:
+                stack.append(t._node)
+
+    leaf_grads: dict[int, tuple] = {}
+    for seq in sorted(seen, reverse=True):
+        node = seen[seq]
+        if all(g is None for g in node.out_grads):
+            continue
+        cts = tuple(
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(node.out_grads, node.out_avals)
+        )
+        # Apply intermediate-tensor hooks before propagating.
+        for k, t in enumerate(node.out_tensors):
+            if t is not None and t._grad_hooks and node.out_grads[k] is not None:
+                g = cts[k]
+                for hook in t._grad_hooks:
+                    res = hook(Tensor._wrap(g, stop_gradient=True))
+                    if res is not None:
+                        g = res._data if isinstance(res, Tensor) else jnp.asarray(g)
+                cts = cts[:k] + (g,) + cts[k + 1 :]
+        in_grads = node.vjp_fn(cts if len(cts) > 1 else cts[0])
+        node.out_grads = [None] * len(node.out_avals)  # release
+        for t, g in zip(node.inputs, in_grads):
+            if t._node is not None:
+                t._node.out_grads[t._out_index] = _add_maybe(
+                    t._node.out_grads[t._out_index], g
+                )
+            elif not t.stop_gradient:
+                prev = leaf_grads.get(id(t))
+                leaf_grads[id(t)] = (t, _add_maybe(prev[1] if prev else None, g))
+
+    for t, g in leaf_grads.values():
+        t._accumulate_grad(g)
+
+
+def _add_maybe(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a + b
+
+
+class Tensor:
+    """Paddle-shaped tensor over a jax.Array (reference: phi::DenseTensor,
+    paddle/phi/core/dense_tensor.h — meta {dims,dtype,layout,place} + holder;
+    here meta and storage both live in the wrapped jax.Array)."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_index", "_grad_hooks", "name", "trainable", "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient=True, name=None):
+        dt = dtypes.convert_dtype(dtype)
+        if isinstance(data, Tensor):
+            arr = data._data
+            if dt is not None and arr.dtype != dt:
+                arr = arr.astype(dt)
+        else:
+            if isinstance(data, (list, tuple)) or np.isscalar(data):
+                data = np.asarray(data)
+            arr = jnp.asarray(data, dtype=dt)
+        self._data = arr
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self._node = None
+        self._out_index = 0
+        self._grad_hooks: list = []
+        self.name = name
+        self.trainable = not stop_gradient
+
+    # -- construction helpers -------------------------------------------------
+    @classmethod
+    def _wrap(cls, arr, stop_gradient=True, name=None):
+        t = cls.__new__(cls)
+        t._data = arr if not isinstance(arr, Tensor) else arr._data
+        t.stop_gradient = stop_gradient
+        t.grad = None
+        t._node = None
+        t._out_index = 0
+        t._grad_hooks = []
+        t.name = name
+        t.trainable = not stop_gradient
+        return t
+
+    # -- jax interop ----------------------------------------------------------
+    def __jax_array__(self):
+        return self._data
+
+    # -- meta -----------------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def T(self):
+        return self.transpose(list(range(self.ndim))[::-1])
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._data.devices())[0]
+            return str(dev)
+        except Exception:
+            return "traced"
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    # -- conversion -----------------------------------------------------------
+    def numpy(self):
+        return np.asarray(jax.device_get(self._data))
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def astype(self, dtype):
+        dt = dtypes.convert_dtype(dtype)
+        return apply_op(lambda a: a.astype(dt), self)
+
+    cast = astype
+
+    def to(self, *args, **kwargs):
+        # Accept .to('bfloat16') / .to(dtype=...) / device no-ops.
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, str) and a in ("cpu", "tpu", "gpu") or a is None:
+                continue
+            dtype = a
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    # -- autograd -------------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        if self.stop_gradient:
+            raise RuntimeError("Tensor has stop_gradient=True; cannot backward().")
+        if grad_tensor is None:
+            seed = jnp.ones(self._data.shape, self._data.dtype)
+        else:
+            seed = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+        _run_backward(self, seed)
+
+    def _accumulate_grad(self, g):
+        if g is None:
+            return
+        if self.grad is None:
+            self.grad = Tensor._wrap(g, stop_gradient=True)
+        else:
+            self.grad = Tensor._wrap(self.grad._data + g, stop_gradient=True)
+        for hook in self._grad_hooks:
+            res = hook(self.grad)
+            if res is not None:
+                self.grad = res if isinstance(res, Tensor) else Tensor._wrap(jnp.asarray(res))
+
+    def register_hook(self, hook: Callable):
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def remove(_s):
+                try:
+                    self._grad_hooks.remove(hook)
+                except ValueError:
+                    pass
+
+        return _Removable()
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self):
+        return Tensor._wrap(self._data, stop_gradient=True, name=self.name)
+
+    def clone(self):
+        return apply_op(lambda a: a + 0, self) if not self.stop_gradient else Tensor._wrap(self._data, stop_gradient=True)
+
+    # -- in-place (leaf) updates ---------------------------------------------
+    def set_value(self, value):
+        arr = value._data if isinstance(value, Tensor) else jnp.asarray(value, dtype=self.dtype)
+        self._data = arr.astype(self._data.dtype) if arr.dtype != self._data.dtype else arr
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    def scale_(self, factor):
+        self._data = self._data * factor
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    # -- operators ------------------------------------------------------------
+    def _binop(self, other, fn, reverse=False):
+        if reverse:
+            return apply_op(lambda b, a=None: fn(a, b) if a is not None else None, other) if isinstance(other, Tensor) else apply_op(lambda a: fn(other, a), self)
+        if isinstance(other, Tensor):
+            return apply_op(fn, self, other)
+        return apply_op(lambda a: fn(a, other), self)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add)
+
+    def __radd__(self, o):
+        return self._binop(o, jnp.add)
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract)
+
+    def __rsub__(self, o):
+        return apply_op(lambda a: jnp.subtract(o, a), self)
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply)
+
+    def __rmul__(self, o):
+        return self._binop(o, jnp.multiply)
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.divide)
+
+    def __rtruediv__(self, o):
+        return apply_op(lambda a: jnp.divide(o, a), self)
+
+    def __floordiv__(self, o):
+        return self._binop(o, jnp.floor_divide)
+
+    def __mod__(self, o):
+        return self._binop(o, jnp.mod)
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power)
+
+    def __rpow__(self, o):
+        return apply_op(lambda a: jnp.power(o, a), self)
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self)
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self)
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul)
+
+    def __rmatmul__(self, o):
+        return apply_op(lambda a: jnp.matmul(o, a), self)
+
+    # comparisons (non-differentiable)
+    def __eq__(self, o):  # type: ignore[override]
+        return Tensor._wrap(self._data == (o._data if isinstance(o, Tensor) else o))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Tensor._wrap(self._data != (o._data if isinstance(o, Tensor) else o))
+
+    def __lt__(self, o):
+        return Tensor._wrap(self._data < (o._data if isinstance(o, Tensor) else o))
+
+    def __le__(self, o):
+        return Tensor._wrap(self._data <= (o._data if isinstance(o, Tensor) else o))
+
+    def __gt__(self, o):
+        return Tensor._wrap(self._data > (o._data if isinstance(o, Tensor) else o))
+
+    def __ge__(self, o):
+        return Tensor._wrap(self._data >= (o._data if isinstance(o, Tensor) else o))
+
+    def __hash__(self):
+        return id(self)
+
+    def __invert__(self):
+        return Tensor._wrap(jnp.logical_not(self._data))
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply_op(lambda a: a[idx], self)
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.numpy().item())
+
+    def __repr__(self):
+        grad_str = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+            f"{grad_str},\n       {np.array2string(self.numpy(), precision=6, threshold=64)})"
+        )
+
+    # -- math methods (delegate to jnp through the tape) ----------------------
+    def _unary(self, fn, **kw):
+        return apply_op(lambda a: fn(a, **kw), self)
+
+    def exp(self):
+        return self._unary(jnp.exp)
+
+    def log(self):
+        return self._unary(jnp.log)
+
+    def sqrt(self):
+        return self._unary(jnp.sqrt)
+
+    def rsqrt(self):
+        return self._unary(jax.lax.rsqrt)
+
+    def sin(self):
+        return self._unary(jnp.sin)
+
+    def cos(self):
+        return self._unary(jnp.cos)
+
+    def tanh(self):
+        return self._unary(jnp.tanh)
+
+    def sigmoid(self):
+        return self._unary(jax.nn.sigmoid)
+
+    def floor(self):
+        return self._unary(jnp.floor)
+
+    def ceil(self):
+        return self._unary(jnp.ceil)
+
+    def round(self):
+        return self._unary(jnp.round)
+
+    def abs(self):
+        return self._unary(jnp.abs)
+
+    def square(self):
+        return self._unary(jnp.square)
+
+    def reciprocal(self):
+        return self._unary(jnp.reciprocal)
+
+    def clip(self, min=None, max=None):
+        return apply_op(lambda a: jnp.clip(a, min, max), self)
+
+    def sum(self, axis=None, keepdim=False, dtype=None):
+        dt = dtypes.convert_dtype(dtype)
+        return apply_op(lambda a: jnp.sum(a, axis=_ax(axis), keepdims=keepdim, dtype=dt), self)
+
+    def mean(self, axis=None, keepdim=False):
+        return apply_op(lambda a: jnp.mean(a, axis=_ax(axis), keepdims=keepdim), self)
+
+    def max(self, axis=None, keepdim=False):
+        return apply_op(lambda a: jnp.max(a, axis=_ax(axis), keepdims=keepdim), self)
+
+    def min(self, axis=None, keepdim=False):
+        return apply_op(lambda a: jnp.min(a, axis=_ax(axis), keepdims=keepdim), self)
+
+    def prod(self, axis=None, keepdim=False):
+        return apply_op(lambda a: jnp.prod(a, axis=_ax(axis), keepdims=keepdim), self)
+
+    def std(self, axis=None, keepdim=False, unbiased=True):
+        return apply_op(lambda a: jnp.std(a, axis=_ax(axis), keepdims=keepdim, ddof=1 if unbiased else 0), self)
+
+    def var(self, axis=None, keepdim=False, unbiased=True):
+        return apply_op(lambda a: jnp.var(a, axis=_ax(axis), keepdims=keepdim, ddof=1 if unbiased else 0), self)
+
+    def argmax(self, axis=None, keepdim=False):
+        return Tensor._wrap(jnp.argmax(self._data, axis=_ax1(axis), keepdims=keepdim))
+
+    def argmin(self, axis=None, keepdim=False):
+        return Tensor._wrap(jnp.argmin(self._data, axis=_ax1(axis), keepdims=keepdim))
+
+    def argsort(self, axis=-1, descending=False):
+        a = jnp.argsort(self._data, axis=axis)
+        if descending:
+            a = jnp.flip(a, axis=axis)
+        return Tensor._wrap(a)
+
+    def sort(self, axis=-1, descending=False):
+        out = jnp.sort(self._data, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return Tensor._wrap(out)
+
+    def topk(self, k, axis=-1, largest=True):
+        from ..ops import math as _m  # lazy; avoids cycle
+
+        return _m.topk(self, k, axis=axis, largest=largest)
+
+    def cumsum(self, axis=None):
+        return apply_op(lambda a: jnp.cumsum(a.reshape(-1) if axis is None else a, axis=0 if axis is None else axis), self)
+
+    def matmul(self, y, transpose_x=False, transpose_y=False):
+        def fn(a, b):
+            if transpose_x:
+                a = jnp.swapaxes(a, -1, -2)
+            if transpose_y:
+                b = jnp.swapaxes(b, -1, -2)
+            return jnp.matmul(a, b)
+
+        return apply_op(fn, self, y) if isinstance(y, Tensor) else apply_op(lambda a: fn(a, y), self)
+
+    def dot(self, y):
+        return apply_op(jnp.dot, self, y)
+
+    def pow(self, y):
+        return self.__pow__(y)
+
+    def add(self, y):
+        return self.__add__(y)
+
+    def add_(self, y):
+        self._data = self._data + (y._data if isinstance(y, Tensor) else y)
+        return self
+
+    def subtract(self, y):
+        return self.__sub__(y)
+
+    def multiply(self, y):
+        return self.__mul__(y)
+
+    def divide(self, y):
+        return self.__truediv__(y)
+
+    def maximum(self, y):
+        return self._binop(y, jnp.maximum)
+
+    def minimum(self, y):
+        return self._binop(y, jnp.minimum)
+
+    def equal(self, y):
+        return self.__eq__(y)
+
+    def not_equal(self, y):
+        return self.__ne__(y)
+
+    def greater_than(self, y):
+        return self.__gt__(y)
+
+    def less_than(self, y):
+        return self.__lt__(y)
+
+    def logical_and(self, y):
+        return Tensor._wrap(jnp.logical_and(self._data, y._data if isinstance(y, Tensor) else y))
+
+    def logical_or(self, y):
+        return Tensor._wrap(jnp.logical_or(self._data, y._data if isinstance(y, Tensor) else y))
+
+    def logical_not(self):
+        return Tensor._wrap(jnp.logical_not(self._data))
+
+    def isnan(self):
+        return Tensor._wrap(jnp.isnan(self._data))
+
+    def isinf(self):
+        return Tensor._wrap(jnp.isinf(self._data))
+
+    def isfinite(self):
+        return Tensor._wrap(jnp.isfinite(self._data))
+
+    def all(self, axis=None, keepdim=False):
+        return Tensor._wrap(jnp.all(self._data, axis=_ax(axis), keepdims=keepdim))
+
+    def any(self, axis=None, keepdim=False):
+        return Tensor._wrap(jnp.any(self._data, axis=_ax(axis), keepdims=keepdim))
+
+    def norm(self, p=2, axis=None, keepdim=False):
+        return apply_op(lambda a: jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim), self)
+
+    # -- shape methods --------------------------------------------------------
+    def reshape(self, shape):
+        shape = _shape_arg(shape)
+        return apply_op(lambda a: jnp.reshape(a, shape), self)
+
+    def reshape_(self, shape):
+        self._data = jnp.reshape(self._data, _shape_arg(shape))
+        return self
+
+    def view(self, shape):
+        return self.reshape(shape)
+
+    def flatten(self, start_axis=0, stop_axis=-1):
+        def fn(a):
+            nd = a.ndim
+            s = start_axis % nd
+            e = stop_axis % nd
+            new_shape = a.shape[:s] + (-1,) + a.shape[e + 1 :]
+            return jnp.reshape(a, new_shape)
+
+        return apply_op(fn, self)
+
+    def transpose(self, perm):
+        perm = _shape_arg(perm)
+        return apply_op(lambda a: jnp.transpose(a, perm), self)
+
+    def squeeze(self, axis=None):
+        return apply_op(lambda a: jnp.squeeze(a, axis=_ax(axis)), self)
+
+    def unsqueeze(self, axis):
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        return apply_op(lambda a: jnp.expand_dims(a, tuple(axes)), self)
+
+    def tile(self, repeat_times):
+        return apply_op(lambda a: jnp.tile(a, _shape_arg(repeat_times)), self)
+
+    def expand(self, shape):
+        shape = _shape_arg(shape)
+        return apply_op(lambda a: jnp.broadcast_to(a, tuple(s if s != -1 else a.shape[i] for i, s in enumerate(shape))), self)
+
+    def broadcast_to(self, shape):
+        return apply_op(lambda a: jnp.broadcast_to(a, _shape_arg(shape)), self)
+
+    def split(self, num_or_sections, axis=0):
+        from ..ops import manipulation as _mp
+
+        return _mp.split(self, num_or_sections, axis=axis)
+
+    def chunk(self, chunks, axis=0):
+        return self.split(chunks, axis=axis)
+
+    def gather(self, index, axis=0):
+        idx = index._data if isinstance(index, Tensor) else index
+        return apply_op(lambda a: jnp.take(a, idx, axis=axis), self)
+
+    def index_select(self, index, axis=0):
+        return self.gather(index, axis=axis)
+
+    def roll(self, shifts, axis=None):
+        return apply_op(lambda a: jnp.roll(a, shifts, axis=axis), self)
+
+    def flip(self, axis):
+        return apply_op(lambda a: jnp.flip(a, axis=axis), self)
+
+    def unbind(self, axis=0):
+        n = self._data.shape[axis]
+        return tuple(self.gather(jnp.array(i), axis=axis).squeeze(axis) for i in range(n))
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def _ax1(axis):
+    return axis
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy())
+    if isinstance(shape, (list, tuple)):
+        return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+    return shape
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    return idx
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: paddle Parameter in python/paddle/base/framework.py).
+
+    ``stop_gradient`` defaults to False; carries optional distributed
+    attributes (sharding spec over the global mesh) used by the parallel
+    layers (SURVEY.md §2 group C)."""
+
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "dist_spec")
+
+    def __init__(self, data, dtype=None, name=None, trainable=True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.dist_spec = None  # jax.sharding.PartitionSpec or None
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (reference: python/paddle/tensor/creation.py)."""
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)

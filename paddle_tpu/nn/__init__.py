@@ -1,0 +1,20 @@
+"""paddle_tpu.nn — the Layer system + layer library (reference: python/paddle/nn/)."""
+from . import functional, initializer
+from .activation import *  # noqa: F401,F403
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .layer import Layer, LayerList, ParameterList, Sequential
+from .loss import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .transformer import (
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,99 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py —
+ClipGradByGlobalNorm/ByNorm/ByValue).
+
+In hybrid-parallel runs the global-norm reduction must span every model-/
+pipeline-/sharding-group (reference: HybridParallelOptimizer's distributed
+ClipGradByGlobalNorm); inside one compiled SPMD step that is a plain psum —
+the distributed trainer handles it. These classes implement the eager path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        sq = None
+        for _, g in params_grads:
+            if g is None:
+                continue
+            s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+            sq = s if sq is None else sq + s
+        if sq is None:
+            return params_grads
+        global_norm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-6), 1.0)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor._wrap((g._data.astype(jnp.float32) * scale).astype(g.dtype))))
+        return out
+
+    # functional variant for the compiled trainer
+    @staticmethod
+    def apply_to_tree(grads_tree, clip_norm):
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(grads_tree)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gn = jnp.sqrt(sq)
+        scale = jnp.minimum(clip_norm / jnp.maximum(gn, 1e-6), 1.0)
+        return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads_tree), gn
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            n = jnp.linalg.norm(g._data.astype(jnp.float32))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-6), 1.0)
+            out.append((p, Tensor._wrap((g._data * scale).astype(g.dtype))))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(-max if min is None else min)
+
+    def __call__(self, params_grads):
+        return [
+            (p, g if g is None else Tensor._wrap(jnp.clip(g._data, self.min, self.max)))
+            for p, g in params_grads
+        ]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(0.0)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(p.grad._data)) for p in params]))
+    else:
+        total = jnp.sum(jnp.stack([
+            jnp.sum(jnp.abs(p.grad._data.astype(jnp.float32)) ** norm_type) for p in params
+        ])) ** (1.0 / norm_type)
+    scale = jnp.minimum(max_norm / jnp.maximum(total, 1e-6), 1.0)
+    for p in params:
+        p.grad._data = (p.grad._data * scale).astype(p.grad.dtype)
+    return Tensor._wrap(total)

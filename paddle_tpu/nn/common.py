@@ -1,0 +1,152 @@
+"""Common layers: Linear, Embedding, Dropout, etc.
+(reference: python/paddle/nn/layer/common.py)."""
+from __future__ import annotations
+
+import math
+
+from ..framework.param_attr import ParamAttr
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["Linear", "Embedding", "Dropout", "Dropout2D", "Flatten", "Identity",
+           "Pad2D", "Upsample", "UpsamplingBilinear2D", "CosineSimilarity", "Bilinear"]
+
+
+class Linear(Layer):
+    """y = xW + b, weight [in_features, out_features] (paddle layout;
+    reference: python/paddle/nn/layer/common.py Linear)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=None if (weight_attr and weight_attr.initializer) else I.XavierNormal(),
+        )
+        if bias_attr is False:
+            self.bias = None
+        else:
+            self.bias = self.create_parameter((out_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+    def extra_repr(self):
+        return f"in_features={self.in_features}, out_features={self.out_features}"
+
+
+class Embedding(Layer):
+    def __init__(self, num_embeddings, embedding_dim, padding_idx=None,
+                 sparse=False, weight_attr=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        self.padding_idx = padding_idx
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=None if (weight_attr and weight_attr.initializer) else I.Normal(0.0, 1.0),
+        )
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, padding_idx=self.padding_idx)
+
+    def extra_repr(self):
+        return f"{self.num_embeddings}, {self.embedding_dim}"
+
+
+class Dropout(Layer):
+    def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.axis, self.mode = p, axis, mode
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training, mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}"
+
+
+class Dropout2D(Layer):
+    def __init__(self, p=0.5, data_format="NCHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout(x, p=self.p, axis=[0, 1], training=self.training)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis=1, stop_axis=-1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        return x.flatten(self.start_axis, self.stop_axis)
+
+
+class Identity(Layer):
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+
+    def forward(self, x):
+        return x
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW"):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value, data_format=self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                 data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+        self.mode, self.align_corners = mode, align_corners
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size, scale_factor=self.scale_factor,
+                             mode=self.mode, align_corners=self.align_corners)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW"):
+        super().__init__(size=size, scale_factor=scale_factor, mode="bilinear", align_corners=True)
+
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis=1, eps=1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class Bilinear(Layer):
+    def __init__(self, in1_features, in2_features, out_features, weight_attr=None, bias_attr=None):
+        super().__init__()
+        bound = 1.0 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features),
+            default_initializer=I.Uniform(-bound, bound),
+        )
+        self.bias = self.create_parameter((1, out_features), is_bias=True)
+
+    def forward(self, x1, x2):
+        from ..framework.tensor import apply_op
+        import jax.numpy as jnp
+
+        return apply_op(
+            lambda a, b, w, bias: jnp.einsum("bi,oij,bj->bo", a, w, b) + bias,
+            x1, x2, self.weight, self.bias,
+        )

@@ -1,0 +1,100 @@
+"""Conv layers (reference: python/paddle/nn/layer/conv.py)."""
+from __future__ import annotations
+
+from ..framework.param_attr import ParamAttr
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv2DTranspose"]
+
+
+def _ntuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+class _ConvNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, nd, stride=1, padding=0,
+                 dilation=1, groups=1, weight_attr=None, bias_attr=None, data_format=None):
+        super().__init__()
+        self.in_channels, self.out_channels = in_channels, out_channels
+        self.kernel_size = _ntuple(kernel_size, nd)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups = groups
+        self.data_format = data_format
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        w_shape = (out_channels, in_channels // groups) + self.kernel_size
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr,
+            default_initializer=None if (weight_attr and weight_attr.initializer) else I.KaimingNormal(),
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def extra_repr(self):
+        return (f"{self.in_channels}, {self.out_channels}, kernel_size={self.kernel_size}, "
+                f"stride={self.stride}, padding={self.padding}")
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 2, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv2d(x, self.weight, self.bias, stride=self.stride,
+                        padding=self.padding, dilation=self.dilation,
+                        groups=self.groups, data_format=self.data_format)
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, 1, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, stride=self.stride,
+                        padding=self.padding, dilation=self.dilation,
+                        groups=self.groups, data_format=self.data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 dilation=1, groups=1, padding_mode="zeros", weight_attr=None,
+                 bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, 3, stride, padding,
+                         dilation, groups, weight_attr, bias_attr, data_format)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, stride=self.stride,
+                        padding=self.padding, dilation=self.dilation,
+                        groups=self.groups, data_format=self.data_format)
+
+
+class Conv2DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, weight_attr=None,
+                 bias_attr=None, data_format="NCHW"):
+        super().__init__()
+        self.stride, self.padding, self.dilation, self.groups = stride, padding, dilation, groups
+        self.output_padding = output_padding
+        k = _ntuple(kernel_size, 2)
+        weight_attr = ParamAttr._to_attr(weight_attr)
+        bias_attr = ParamAttr._to_attr(bias_attr)
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + k, attr=weight_attr,
+            default_initializer=None if (weight_attr and weight_attr.initializer) else I.KaimingNormal(),
+        )
+        self.bias = None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, output_size=None):
+        return F.conv2d_transpose(x, self.weight, self.bias, stride=self.stride,
+                                  padding=self.padding, output_padding=self.output_padding,
+                                  dilation=self.dilation, groups=self.groups,
+                                  output_size=output_size)

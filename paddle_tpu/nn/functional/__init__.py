@@ -1,0 +1,76 @@
+"""nn.functional (reference: python/paddle/nn/functional/).
+
+Every function takes Tensors, applies the active AMP policy, and routes the
+pure-jax computation through apply_op so both eager autograd and jit tracing
+work. Convs/matmuls hit the MXU via lax; normalization/softmax stay fp32
+under AMP.
+"""
+from .common import (
+    linear,
+    dropout,
+    embedding,
+    pad,
+    interpolate,
+    unfold,
+    one_hot,
+    label_smooth,
+    cosine_similarity,
+    normalize,
+)
+from .conv import conv1d, conv2d, conv3d, conv2d_transpose
+from .pooling import (
+    avg_pool1d,
+    avg_pool2d,
+    max_pool1d,
+    max_pool2d,
+    adaptive_avg_pool1d,
+    adaptive_avg_pool2d,
+    adaptive_max_pool2d,
+)
+from .norm import batch_norm, layer_norm, group_norm, rms_norm, local_response_norm
+from .activation import (
+    relu,
+    relu6,
+    relu_,
+    gelu,
+    silu,
+    swish,
+    sigmoid,
+    tanh,
+    softmax,
+    log_softmax,
+    leaky_relu,
+    elu,
+    selu,
+    celu,
+    hardswish,
+    hardsigmoid,
+    hardtanh,
+    hardshrink,
+    softshrink,
+    softplus,
+    softsign,
+    mish,
+    tanhshrink,
+    prelu,
+    glu,
+    gumbel_softmax,
+)
+from .loss import (
+    cross_entropy,
+    softmax_with_cross_entropy,
+    binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    mse_loss,
+    l1_loss,
+    nll_loss,
+    kl_div,
+    smooth_l1_loss,
+    margin_ranking_loss,
+    cosine_embedding_loss,
+    ctc_loss,
+    square_error_cost,
+)
+from .attention import scaled_dot_product_attention, flash_attention
+
+__all__ = [n for n in dir() if not n.startswith("_")]

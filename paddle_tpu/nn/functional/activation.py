@@ -1,0 +1,156 @@
+"""Activation functional ops (reference: paddle/phi/kernels/gpu/activation_kernel.cu)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as _random
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["relu", "relu_", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh",
+           "softmax", "log_softmax", "leaky_relu", "elu", "selu", "celu",
+           "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "softshrink",
+           "softplus", "softsign", "mish", "tanhshrink", "prelu", "glu",
+           "gumbel_softmax"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _u(fn, x, **kw):
+    return apply_op(lambda a: fn(a, **kw), _t(x))
+
+
+def relu(x, name=None):
+    return _u(jax.nn.relu, x)
+
+
+def relu_(x):
+    out = relu(x)
+    x.set_value(out)
+    return x
+
+
+def relu6(x):
+    return _u(jax.nn.relu6, x)
+
+
+def gelu(x, approximate=False):
+    return _u(lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x):
+    return _u(jax.nn.silu, x)
+
+
+def swish(x):
+    return _u(jax.nn.silu, x)
+
+
+def sigmoid(x):
+    return _u(jax.nn.sigmoid, x)
+
+
+def tanh(x):
+    return _u(jnp.tanh, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def fn(a):
+        af = a.astype(jnp.float32)
+        out = jax.nn.softmax(af, axis=axis)
+        return out.astype(a.dtype if dtype is None else dtype)
+
+    return apply_op(fn, _t(x))
+
+
+def log_softmax(x, axis=-1, dtype=None):
+    def fn(a):
+        af = a.astype(jnp.float32)
+        out = jax.nn.log_softmax(af, axis=axis)
+        return out.astype(a.dtype if dtype is None else dtype)
+
+    return apply_op(fn, _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _u(lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def elu(x, alpha=1.0):
+    return _u(lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return _u(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0):
+    return _u(lambda a: jax.nn.celu(a, alpha), x)
+
+
+def hardswish(x):
+    return _u(jax.nn.hard_swish, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5):
+    return _u(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return _u(lambda a: jnp.clip(a, min, max), x)
+
+
+def hardshrink(x, threshold=0.5):
+    return _u(lambda a: jnp.where(jnp.abs(a) > threshold, a, jnp.zeros((), a.dtype)), x)
+
+
+def softshrink(x, threshold=0.5):
+    return _u(lambda a: jnp.sign(a) * jnp.maximum(jnp.abs(a) - threshold, 0.0), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return _u(lambda a: jnp.where(beta * a > threshold, a, jnp.log1p(jnp.exp(beta * a)) / beta), x)
+
+
+def softsign(x):
+    return _u(jax.nn.soft_sign, x)
+
+
+def mish(x):
+    return _u(lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def tanhshrink(x):
+    return _u(lambda a: a - jnp.tanh(a), x)
+
+
+def prelu(x, weight):
+    return apply_op(lambda a, w: jnp.where(a > 0, a, w.reshape((1, -1) + (1,) * (a.ndim - 2)) * a), _t(x), _t(weight))
+
+
+def glu(x, axis=-1):
+    def fn(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+
+    return apply_op(fn, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    key = _random.op_key()
+
+    def fn(a):
+        g = -jnp.log(-jnp.log(jax.random.uniform(key, a.shape) + 1e-20) + 1e-20)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y).at[
+                tuple(jnp.indices(y.shape)[i] if i != (axis % y.ndim) else idx
+                      for i in range(y.ndim))
+            ].set(1.0)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+
+    return apply_op(fn, _t(x))

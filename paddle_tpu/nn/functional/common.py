@@ -1,0 +1,149 @@
+"""Common functional ops: linear, dropout, embedding, pad, interpolate."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...amp import amp_cast
+from ...framework import random as _random
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["linear", "dropout", "embedding", "pad", "interpolate", "unfold",
+           "one_hot", "label_smooth", "cosine_similarity", "normalize"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b with paddle weight layout [in_features, out_features]
+    (reference: paddle/phi/kernels/impl/matmul_kernel_impl.h via nn.Linear)."""
+    x, weight = amp_cast("linear", _t(x), _t(weight))
+    if bias is not None:
+        (bias,) = amp_cast("linear", _t(bias))
+        return apply_op(lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+    return apply_op(jnp.matmul, x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    key = _random.op_key()
+
+    def fn(a):
+        shape = a.shape if axis is None else tuple(
+            a.shape[i] if (i in (axis if isinstance(axis, (list, tuple)) else [axis])) else 1
+            for i in range(a.ndim)
+        )
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        out = jnp.where(keep, a, jnp.zeros((), a.dtype))
+        if mode == "upscale_in_train":
+            out = out / (1.0 - p)
+        return out
+
+    return apply_op(fn, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Lookup rows of weight [vocab, dim] (reference: phi embedding kernel;
+    vocab-parallel variant lives in distributed.fleet.meta_parallel)."""
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    weight = _t(weight)
+
+    def fn(w):
+        out = jnp.take(w, idx, axis=0)
+        if padding_idx is not None and padding_idx >= 0:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros((), out.dtype), out)
+        return out
+
+    return apply_op(fn, weight)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW"):
+    x = _t(x)
+
+    def fn(a):
+        if isinstance(pad, (list, tuple)) and len(pad) == a.ndim * 2:
+            widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # paddle style: pad applies to last len(pad)//2 dims, reversed pairs
+            n = len(pad) // 2
+            widths = [(0, 0)] * (a.ndim - n)
+            for i in range(n):
+                widths.append((pad[2 * i], pad[2 * i + 1]))
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+
+    return apply_op(fn, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    x = _t(x)
+    n, c, h, w = x._data.shape
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else (scale_factor, scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+
+    def fn(a):
+        # jax.image.resize operates on spatial dims; NCHW → resize dims 2,3
+        return jax.image.resize(a, (a.shape[0], a.shape[1], size[0], size[1]), method=method)
+
+    return apply_op(fn, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    x = _t(x)
+    k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else (kernel_sizes, kernel_sizes)
+    s = strides if isinstance(strides, (list, tuple)) else (strides, strides)
+    p = paddings if isinstance(paddings, (list, tuple)) else (paddings, paddings)
+    d = dilations if isinstance(dilations, (list, tuple)) else (dilations, dilations)
+
+    def fn(a):
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        n, ckk, oh, ow = patches.shape
+        return patches.reshape(n, ckk, oh * ow)
+
+    return apply_op(fn, x)
+
+
+def one_hot(x, num_classes):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(jax.nn.one_hot(idx, num_classes))
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    label = _t(label)
+
+    def fn(l):
+        k = l.shape[-1]
+        uniform = 1.0 / k if prior_dist is None else jnp.asarray(getattr(prior_dist, "_data", prior_dist))
+        return (1 - epsilon) * l + epsilon * uniform
+
+    return apply_op(fn, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return apply_op(
+        lambda a, b: jnp.sum(a * b, axis=axis)
+        / jnp.maximum(jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis), eps),
+        _t(x1), _t(x2),
+    )
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12):
+    return apply_op(
+        lambda a: a / jnp.maximum(jnp.linalg.norm(a, ord=p, axis=axis, keepdims=True), epsilon),
+        _t(x),
+    )

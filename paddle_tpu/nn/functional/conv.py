@@ -1,0 +1,117 @@
+"""Convolutions via lax.conv_general_dilated (MXU path).
+
+Reference: paddle/phi/kernels/gpu/conv_kernel.cu (cuDNN). XLA lowers these
+directly onto the MXU with layout assignment; no per-backend kernel needed.
+Weight layout is paddle's OIHW; activations NCHW by default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...amp import amp_cast
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv2d_transpose"]
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _padding_arg(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _pair(padding, nd)
+    if len(p) == nd:
+        return [(int(x), int(x)) for x in p]
+    # already pairs
+    return [tuple(pp) for pp in p]
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    x, weight = amp_cast("conv2d", _t(x), _t(weight))
+    s, d = _pair(stride), _pair(dilation)
+    pad = _padding_arg(padding, 2)
+    dn = (data_format, "OIHW", data_format)
+
+    def fn(a, w):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=s, padding=pad, rhs_dilation=d,
+            feature_group_count=groups, dimension_numbers=dn,
+        )
+        return out
+
+    out = apply_op(fn, x, weight)
+    if bias is not None:
+        bias = _t(bias)
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = apply_op(lambda o, b: o + b.reshape(shape), out, bias)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    x, weight = amp_cast("conv1d", _t(x), _t(weight))
+    s, d = _pair(stride, 1), _pair(dilation, 1)
+    pad = _padding_arg(padding, 1)
+    dn = ("NCH", "OIH", "NCH") if data_format == "NCL" else ("NHC", "OIH", "NHC")
+
+    def fn(a, w):
+        return jax.lax.conv_general_dilated(
+            a, w, window_strides=s, padding=pad, rhs_dilation=d,
+            feature_group_count=groups, dimension_numbers=dn,
+        )
+
+    out = apply_op(fn, x, weight)
+    if bias is not None:
+        out = apply_op(lambda o, b: o + b.reshape(1, -1, 1), out, _t(bias))
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    x, weight = amp_cast("conv3d", _t(x), _t(weight))
+    s, d = _pair(stride, 3), _pair(dilation, 3)
+    pad = _padding_arg(padding, 3)
+    dn = (data_format, "OIDHW", data_format)
+
+    def fn(a, w):
+        return jax.lax.conv_general_dilated(
+            a, w, window_strides=s, padding=pad, rhs_dilation=d,
+            feature_group_count=groups, dimension_numbers=dn,
+        )
+
+    out = apply_op(fn, x, weight)
+    if bias is not None:
+        out = apply_op(lambda o, b: o + b.reshape(1, -1, 1, 1, 1), out, _t(bias))
+    return out
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCHW"):
+    x, weight = amp_cast("conv2d", _t(x), _t(weight))
+    s, d = _pair(stride), _pair(dilation)
+    p = _pair(padding)
+
+    def fn(a, w):
+        # paddle transpose-conv weight layout: [in_c, out_c/groups, kh, kw]
+        return jax.lax.conv_transpose(
+            a, w, strides=s,
+            padding=[(p[0], p[0]), (p[1], p[1])],
+            rhs_dilation=d,
+            dimension_numbers=(data_format, "IOHW", data_format),
+            transpose_kernel=True,
+        )
+
+    out = apply_op(fn, x, weight)
+    if bias is not None:
+        out = apply_op(lambda o, b: o + b.reshape(1, -1, 1, 1), out, _t(bias))
+    return out

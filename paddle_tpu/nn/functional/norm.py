@@ -1,0 +1,143 @@
+"""Normalization functional ops (reference: paddle/phi/kernels/gpu/
+{batch_norm,layer_norm,group_norm}_kernel.cu; rms_norm fusion kernel).
+
+Stats math runs in fp32 regardless of input dtype (TPU bf16 discipline);
+outputs cast back to the input dtype. XLA fuses the whole normalization into
+neighbouring ops, replacing the reference's hand-fused variants.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "rms_norm", "local_response_norm"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None):
+    """Returns output; updates running stats in-place on the passed tensors
+    when training (matching paddle's mutable-buffer semantics)."""
+    x = _t(x)
+    axes = (0, 2, 3) if x._data.ndim == 4 else ((0,) if x._data.ndim == 2 else (0, 2))
+    shape = [1, -1] + [1] * (x._data.ndim - 2)
+    use_stats = (not training) if use_global_stats is None else use_global_stats
+
+    if use_stats:
+        mean = running_mean._data.astype(jnp.float32)
+        var = running_var._data.astype(jnp.float32)
+
+        def fn(a, *wb):
+            xf = a.astype(jnp.float32)
+            out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+            out = _affine(out, wb, shape)
+            return out.astype(a.dtype)
+
+        args = [p for p in (weight, bias) if p is not None]
+        return apply_op(fn, x, *args)
+
+    # training: batch stats + update running buffers eagerly (host-side state)
+    def fn(a, *wb):
+        xf = a.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes)
+        var = jnp.var(xf, axis=axes)
+        out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        out = _affine(out, wb, shape)
+        return out.astype(a.dtype), mean, var
+
+    args = [p for p in (weight, bias) if p is not None]
+    out, mean_t, var_t = apply_op(fn, x, *args)
+    # buffer update: not differentiated
+    rm, rv = running_mean._data.astype(jnp.float32), running_var._data.astype(jnp.float32)
+    running_mean._data = (momentum * rm + (1 - momentum) * mean_t._data).astype(running_mean.dtype)
+    running_var._data = (momentum * rv + (1 - momentum) * var_t._data).astype(running_var.dtype)
+    return out
+
+
+def _affine(out, wb, shape):
+    if len(wb) == 2:
+        w, b = wb
+        return out * w.astype(out.dtype).reshape(shape) + b.astype(out.dtype).reshape(shape)
+    if len(wb) == 1:
+        return out * wb[0].astype(out.dtype).reshape(shape)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    nd = len(tuple(normalized_shape))
+    axes = tuple(range(x._data.ndim - nd, x._data.ndim))
+
+    def fn(a, *wb):
+        xf = a.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+        if len(wb) == 2:
+            out = out * wb[0].astype(jnp.float32) + wb[1].astype(jnp.float32)
+        elif len(wb) == 1:
+            out = out * wb[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [_t(p) for p in (weight, bias) if p is not None]
+    return apply_op(fn, x, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
+    """RMSNorm (reference: paddle/phi/kernels/fusion/gpu/rms_norm_kernel.cu —
+    here a plain jnp composite; XLA fuses it)."""
+    x = _t(x)
+
+    def fn(a, *w):
+        xf = a.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+        out = xf * jax.lax.rsqrt(ms + epsilon)
+        if w:
+            out = out * w[0].astype(jnp.float32)
+        return out.astype(a.dtype)
+
+    args = [_t(weight)] if weight is not None else []
+    return apply_op(fn, x, *args)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
+    x = _t(x)
+
+    def fn(a, *wb):
+        n, c = a.shape[:2]
+        spatial = a.shape[2:]
+        xf = a.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, xf.ndim))
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * len(spatial)
+        if len(wb) == 2:
+            out = out * wb[0].astype(jnp.float32).reshape(shape) + wb[1].astype(jnp.float32).reshape(shape)
+        return out.astype(a.dtype)
+
+    args = [_t(p) for p in (weight, bias) if p is not None]
+    return apply_op(fn, x, *args)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0):
+    x = _t(x)
+
+    def fn(a):
+        sq = jnp.square(a)
+        half = size // 2
+        summed = jax.lax.reduce_window(
+            sq, 0.0, jax.lax.add,
+            window_dimensions=(1, size, 1, 1), window_strides=(1, 1, 1, 1),
+            padding=((0, 0), (half, size - 1 - half), (0, 0), (0, 0)),
+        )
+        return a / jnp.power(k + alpha * summed, beta)
+
+    return apply_op(fn, x)

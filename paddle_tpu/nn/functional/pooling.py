@@ -1,0 +1,152 @@
+"""Pooling via lax.reduce_window (reference: paddle/phi/kernels/gpu/pool_kernel.cu)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["max_pool2d", "avg_pool2d", "max_pool1d", "avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool1d", "adaptive_max_pool2d"]
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW"):
+    k, s = _pair(kernel_size), _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+
+    def fn(a):
+        neg = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        return jax.lax.reduce_window(
+            a, neg, jax.lax.max,
+            window_dimensions=(1, 1, k[0], k[1]),
+            window_strides=(1, 1, s[0], s[1]),
+            padding=((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+        )
+
+    return apply_op(fn, _t(x))
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW"):
+    k, s = _pair(kernel_size), _pair(stride if stride is not None else kernel_size)
+    p = _pair(padding)
+
+    def fn(a):
+        summed = jax.lax.reduce_window(
+            a, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, k[0], k[1]),
+            window_strides=(1, 1, s[0], s[1]),
+            padding=((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+        )
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and (p[0] or p[1]):
+            ones = jnp.ones(a.shape[-2:], a.dtype)[None, None]
+            counts = jax.lax.reduce_window(
+                jnp.broadcast_to(ones, (1, 1) + a.shape[-2:]), 0.0, jax.lax.add,
+                window_dimensions=(1, 1, k[0], k[1]),
+                window_strides=(1, 1, s[0], s[1]),
+                padding=((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+            )
+            return summed / counts
+        return summed / (k[0] * k[1])
+
+    return apply_op(fn, _t(x))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, ceil_mode=False, return_mask=False):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def fn(a):
+        return jax.lax.reduce_window(
+            a, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 1, k), window_strides=(1, 1, s),
+            padding=((0, 0), (0, 0), (p, p)),
+        )
+
+    return apply_op(fn, _t(x))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False):
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = k if stride is None else (stride if isinstance(stride, int) else stride[0])
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def fn(a):
+        summed = jax.lax.reduce_window(
+            a, 0.0, jax.lax.add,
+            window_dimensions=(1, 1, k), window_strides=(1, 1, s),
+            padding=((0, 0), (0, 0), (p, p)),
+        )
+        return summed / k
+
+    return apply_op(fn, _t(x))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    x = _t(x)
+    oh, ow = _pair(output_size)
+    _, _, h, w = x._data.shape
+    if h % oh == 0 and w % ow == 0:
+        kh, kw = h // oh, w // ow
+
+        def fn(a):
+            return jax.lax.reduce_window(
+                a, 0.0, jax.lax.add,
+                window_dimensions=(1, 1, kh, kw), window_strides=(1, 1, kh, kw),
+                padding="VALID",
+            ) / (kh * kw)
+
+        return apply_op(fn, x)
+
+    # general: mean over index buckets
+    def fn(a):
+        hs = np.linspace(0, h, oh + 1).astype(int)
+        ws = np.linspace(0, w, ow + 1).astype(int)
+        rows = [jnp.stack([a[..., hs[i]:hs[i + 1], ws[j]:ws[j + 1]].mean(axis=(-1, -2))
+                           for j in range(ow)], axis=-1) for i in range(oh)]
+        return jnp.stack(rows, axis=-2)
+
+    return apply_op(fn, x)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    x = _t(x)
+    o = output_size if isinstance(output_size, int) else output_size[0]
+    l = x._data.shape[-1]
+    assert l % o == 0, "adaptive_avg_pool1d requires divisible length"
+    k = l // o
+
+    def fn(a):
+        return a.reshape(*a.shape[:-1], o, k).mean(-1)
+
+    return apply_op(fn, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    x = _t(x)
+    oh, ow = _pair(output_size)
+    _, _, h, w = x._data.shape
+    assert h % oh == 0 and w % ow == 0
+    kh, kw = h // oh, w // ow
+
+    def fn(a):
+        return jax.lax.reduce_window(
+            a, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, 1, kh, kw), window_strides=(1, 1, kh, kw),
+            padding="VALID",
+        )
+
+    return apply_op(fn, x)

@@ -1,0 +1,10 @@
+"""Functional op library over jnp/lax (the Phi-kernel analogue; SURVEY.md A1/A2).
+
+jax.numpy/lax replaces the reference's ~600 hand-written per-backend kernels
+(paddle/phi/kernels/{cpu,gpu}); the `pallas/` subpackage holds the hand-fused
+kernels that replace paddle/phi/kernels/fusion/gpu (SURVEY.md A3.x).
+"""
+from . import creation, linalg, manipulation, math
+from .creation import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403

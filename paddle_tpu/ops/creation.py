@@ -1,0 +1,172 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtypes, random as _random
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "to_tensor",
+    "zeros",
+    "ones",
+    "full",
+    "zeros_like",
+    "ones_like",
+    "full_like",
+    "empty",
+    "empty_like",
+    "arange",
+    "linspace",
+    "eye",
+    "rand",
+    "randn",
+    "randint",
+    "uniform",
+    "normal",
+    "randperm",
+    "tril",
+    "triu",
+    "diag",
+    "meshgrid",
+    "assign",
+    "clone",
+]
+
+
+def _dt(dtype, default=None):
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtypes.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None):
+    return Tensor._wrap(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None):
+    return Tensor._wrap(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None):
+    return Tensor._wrap(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def zeros_like(x, dtype=None):
+    return Tensor._wrap(jnp.zeros_like(_arr(x), dtype=dtypes.convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None):
+    return Tensor._wrap(jnp.ones_like(_arr(x), dtype=dtypes.convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None):
+    return Tensor._wrap(jnp.full_like(_arr(x), fill_value, dtype=dtypes.convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    d = dtypes.convert_dtype(dtype)
+    if d is None:
+        d = np.int64 if all(isinstance(v, int) for v in (start, end, step)) else dtypes.get_default_dtype()
+    return Tensor._wrap(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None):
+    return Tensor._wrap(jnp.linspace(start, stop, num, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None):
+    return Tensor._wrap(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def rand(shape, dtype=None):
+    return Tensor._wrap(jax.random.uniform(_random.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None):
+    return Tensor._wrap(jax.random.normal(_random.next_key(), _shape(shape), _dt(dtype)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None):
+    if high is None:
+        low, high = 0, low
+    d = _dt(dtype, np.dtype(np.int64))
+    return Tensor._wrap(jax.random.randint(_random.next_key(), _shape(shape), low, high, dtype=d))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0):
+    return Tensor._wrap(
+        jax.random.uniform(_random.next_key(), _shape(shape), _dt(dtype), minval=min, maxval=max)
+    )
+
+
+def normal(mean=0.0, std=1.0, shape=None):
+    return Tensor._wrap(mean + std * jax.random.normal(_random.next_key(), _shape(shape or [1]), dtypes.get_default_dtype()))
+
+
+def randperm(n, dtype="int64"):
+    return Tensor._wrap(jax.random.permutation(_random.next_key(), n).astype(dtypes.convert_dtype(dtype)))
+
+
+def tril(x, diagonal=0):
+    from ..framework.tensor import apply_op
+
+    return apply_op(lambda a: jnp.tril(a, diagonal), x)
+
+
+def triu(x, diagonal=0):
+    from ..framework.tensor import apply_op
+
+    return apply_op(lambda a: jnp.triu(a, diagonal), x)
+
+
+def diag(x, offset=0):
+    from ..framework.tensor import apply_op
+
+    return apply_op(lambda a: jnp.diag(a, offset), x)
+
+
+def meshgrid(*args):
+    arrs = jnp.meshgrid(*[_arr(a) for a in args], indexing="ij")
+    return [Tensor._wrap(a) for a in arrs]
+
+
+def assign(x, output=None):
+    t = Tensor(x) if not isinstance(x, Tensor) else Tensor._wrap(x._data)
+    if output is not None:
+        output.set_value(t)
+        return output
+    return t
+
+
+def clone(x):
+    return x.clone() if isinstance(x, Tensor) else Tensor(x)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in shape.numpy())
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)

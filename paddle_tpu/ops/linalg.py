@@ -1,0 +1,104 @@
+"""Linear-algebra ops (reference: python/paddle/tensor/linalg.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["norm", "matmul", "t", "transpose", "dist", "cond", "inv", "det",
+           "slogdet", "svd", "qr", "eigh", "cholesky", "solve", "lstsq",
+           "pinv", "matrix_power", "cross", "histogram"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def norm(x, p=2, axis=None, keepdim=False):
+    if p == "fro":
+        p = None
+    return apply_op(lambda a: jnp.linalg.norm(a, ord=p, axis=tuple(axis) if isinstance(axis, list) else axis, keepdims=keepdim), _t(x))
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    return _t(x).matmul(y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+def t(x):
+    return _t(x).T
+
+
+def transpose(x, perm):
+    return _t(x).transpose(perm)
+
+
+def dist(x, y, p=2):
+    return apply_op(lambda a, b: jnp.linalg.norm((a - b).ravel(), ord=p), _t(x), _t(y))
+
+
+def cond(x, p=None):
+    return Tensor._wrap(jnp.linalg.cond(_t(x)._data, p=p))
+
+
+def inv(x):
+    return apply_op(jnp.linalg.inv, _t(x))
+
+
+def det(x):
+    return apply_op(jnp.linalg.det, _t(x))
+
+
+def slogdet(x):
+    sign, logdet = jnp.linalg.slogdet(_t(x)._data)
+    return Tensor._wrap(jnp.stack([sign, logdet]))
+
+
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(_t(x)._data, full_matrices=full_matrices)
+    return Tensor._wrap(u), Tensor._wrap(s), Tensor._wrap(vh)
+
+
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(_t(x)._data, mode=mode)
+    return Tensor._wrap(q), Tensor._wrap(r)
+
+
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(_t(x)._data, UPLO=UPLO)
+    return Tensor._wrap(w), Tensor._wrap(v)
+
+
+def cholesky(x, upper=False):
+    def fn(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply_op(fn, _t(x))
+
+
+def solve(x, y):
+    return apply_op(jnp.linalg.solve, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None):
+    sol = jnp.linalg.lstsq(_t(x)._data, _t(y)._data, rcond=rcond)
+    return tuple(Tensor._wrap(s) for s in sol)
+
+
+def pinv(x, rcond=1e-15):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond), _t(x))
+
+
+def matrix_power(x, n):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), _t(x))
+
+
+def cross(x, y, axis=-1):
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=axis), _t(x), _t(y))
+
+
+def histogram(x, bins=100, min=0, max=0):
+    arr = _t(x)._data
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    h, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor._wrap(h)

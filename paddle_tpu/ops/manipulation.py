@@ -1,0 +1,206 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+
+_slice = slice  # builtin, shadowed by the paddle-named `slice` op below
+
+__all__ = [
+    "reshape", "flatten", "transpose", "squeeze", "unsqueeze", "concat",
+    "stack", "split", "chunk", "tile", "expand", "broadcast_to", "gather",
+    "gather_nd", "scatter", "index_select", "masked_select", "roll", "flip",
+    "unbind", "take_along_axis", "put_along_axis", "repeat_interleave",
+    "moveaxis", "swapaxes", "unstack", "as_complex", "as_real", "cast",
+    "slice", "strided_slice", "expand_as", "one_hot",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def reshape(x, shape, name=None):
+    return _t(x).reshape(shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    return _t(x).flatten(start_axis, stop_axis)
+
+
+def transpose(x, perm, name=None):
+    return _t(x).transpose(perm)
+
+
+def squeeze(x, axis=None):
+    return _t(x).squeeze(axis)
+
+
+def unsqueeze(x, axis):
+    return _t(x).unsqueeze(axis)
+
+
+def concat(xs, axis=0, name=None):
+    ts = [_t(x) for x in xs]
+    return apply_op(lambda *arrs: jnp.concatenate(arrs, axis=axis), *ts)
+
+
+def stack(xs, axis=0, name=None):
+    ts = [_t(x) for x in xs]
+    return apply_op(lambda *arrs: jnp.stack(arrs, axis=axis), *ts)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    dim = x._data.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [dim // len(num_or_sections) if s in (-1, None) else s for s in num_or_sections]
+        rem = dim - sum(s for s in sizes)
+        # paddle allows one -1 entry
+        if rem:
+            for i, s in enumerate(num_or_sections):
+                if s in (-1, None):
+                    sizes[i] += rem
+                    break
+    offsets = [0]
+    for s in sizes:
+        offsets.append(offsets[-1] + s)
+
+    def fn(a):
+        return tuple(jnp.take(a, jnp.arange(offsets[i], offsets[i + 1]), axis=axis) for i in range(len(sizes)))
+
+    return list(apply_op(fn, x))
+
+
+def chunk(x, chunks, axis=0):
+    return split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times):
+    return _t(x).tile(repeat_times)
+
+
+def expand(x, shape):
+    return _t(x).expand(shape)
+
+
+def expand_as(x, y):
+    return _t(x).broadcast_to(_t(y).shape)
+
+
+def broadcast_to(x, shape):
+    return _t(x).broadcast_to(shape)
+
+
+def gather(x, index, axis=0):
+    return _t(x).gather(index, axis=axis)
+
+
+def gather_nd(x, index):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a):
+        return a[tuple(jnp.moveaxis(idx, -1, 0))]
+
+    return apply_op(fn, _t(x))
+
+
+def scatter(x, index, updates, overwrite=True):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+
+    def fn(a, u):
+        return a.at[idx].set(u) if overwrite else a.at[idx].add(u)
+
+    return apply_op(fn, _t(x), _t(updates))
+
+
+def index_select(x, index, axis=0):
+    return _t(x).gather(index, axis=axis)
+
+
+def masked_select(x, mask):
+    m = mask._data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return Tensor._wrap(_t(x)._data[m])
+
+
+def roll(x, shifts, axis=None):
+    return _t(x).roll(shifts, axis)
+
+
+def flip(x, axis):
+    return _t(x).flip(axis)
+
+
+def unbind(x, axis=0):
+    return list(_t(x).unbind(axis))
+
+
+def unstack(x, axis=0):
+    return unbind(x, axis)
+
+
+def take_along_axis(x, indices, axis):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply_op(lambda a: jnp.take_along_axis(a, idx, axis=axis), _t(x))
+
+
+def put_along_axis(x, indices, values, axis):
+    idx = indices._data if isinstance(indices, Tensor) else jnp.asarray(indices)
+    return apply_op(lambda a, v: jnp.put_along_axis(a, idx, v, axis=axis, inplace=False), _t(x), _t(values))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), _t(x))
+
+
+def moveaxis(x, source, destination):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), _t(x))
+
+
+def swapaxes(x, axis1, axis2):
+    return apply_op(lambda a: jnp.swapaxes(a, axis1, axis2), _t(x))
+
+
+def as_complex(x):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def as_real(x):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x))
+
+
+def cast(x, dtype):
+    return _t(x).astype(dtype)
+
+
+def slice(x, axes, starts, ends):
+    x = _t(x)
+
+    def fn(a):
+        idx = [_slice(None)] * a.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = _slice(s, e)
+        return a[tuple(idx)]
+
+    return apply_op(fn, x)
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    x = _t(x)
+
+    def fn(a):
+        idx = [_slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = _slice(s, e, st)
+        return a[tuple(idx)]
+
+    return apply_op(fn, x)
+
+
+def one_hot(x, num_classes):
+    idx = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._wrap(jax.nn.one_hot(idx, num_classes))

@@ -1,0 +1,330 @@
+"""Math / reduction / comparison ops (reference: python/paddle/tensor/math.py).
+
+Each function accepts Tensors (or array-likes) and routes through apply_op so
+eager autograd records VJPs; under jit tracing the same code paths carry jax
+derivatives natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "matmul", "pow", "floor_divide",
+    "remainder", "exp", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+    "square", "abs", "sign", "sin", "cos", "tan", "asin", "acos", "atan",
+    "sinh", "cosh", "tanh", "erf", "floor", "ceil", "round", "reciprocal",
+    "clip", "maximum", "minimum", "sum", "mean", "max", "min", "prod", "std",
+    "var", "cumsum", "cumprod", "logsumexp", "argmax", "argmin", "topk",
+    "sort", "argsort", "isnan", "isinf", "isfinite", "equal", "not_equal",
+    "greater_than", "greater_equal", "less_than", "less_equal", "logical_and",
+    "logical_or", "logical_not", "logical_xor", "all", "any", "where",
+    "scale", "stanh", "multiplex", "addmm", "outer", "inner", "dot", "mm",
+    "bmm", "trace", "kron", "diff", "nan_to_num", "lerp", "allclose", "isclose",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _binary(fn, x, y):
+    x = _t(x)
+    if isinstance(y, Tensor):
+        return apply_op(fn, x, y)
+    return apply_op(lambda a: fn(a, y), x)
+
+
+def _unary(fn, x, **kw):
+    return apply_op(lambda a: fn(a, **kw), _t(x))
+
+
+def add(x, y, name=None):
+    return _binary(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return _binary(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return _binary(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return _binary(jnp.divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _binary(jnp.floor_divide, x, y)
+
+
+def remainder(x, y, name=None):
+    return _binary(jnp.mod, x, y)
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    return _binary(jnp.power, x, y)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    return _t(x).matmul(y, transpose_x=transpose_x, transpose_y=transpose_y)
+
+
+mm = matmul
+
+
+def bmm(x, y):
+    return _binary(jnp.matmul, x, y)
+
+
+def dot(x, y):
+    return _binary(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def outer(x, y):
+    return _binary(jnp.outer, x, y)
+
+
+def inner(x, y):
+    return _binary(jnp.inner, x, y)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), _t(input), _t(x), _t(y))
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return _unary(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def kron(x, y):
+    return _binary(jnp.kron, x, y)
+
+
+for _name, _fn in [
+    ("exp", jnp.exp), ("log", jnp.log), ("log2", jnp.log2), ("log10", jnp.log10),
+    ("log1p", jnp.log1p), ("sqrt", jnp.sqrt), ("rsqrt", jax.lax.rsqrt),
+    ("square", jnp.square), ("abs", jnp.abs), ("sign", jnp.sign),
+    ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan), ("asin", jnp.arcsin),
+    ("acos", jnp.arccos), ("atan", jnp.arctan), ("sinh", jnp.sinh),
+    ("cosh", jnp.cosh), ("tanh", jnp.tanh), ("erf", jax.lax.erf),
+    ("floor", jnp.floor), ("ceil", jnp.ceil), ("round", jnp.round),
+    ("reciprocal", jnp.reciprocal),
+]:
+    def _mk(fn):
+        def f(x, name=None):
+            return _unary(fn, x)
+        return f
+    globals()[_name] = _mk(_fn)
+
+
+def clip(x, min=None, max=None, name=None):
+    return _unary(lambda a: jnp.clip(a, min, max), x)
+
+
+def maximum(x, y, name=None):
+    return _binary(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return _binary(jnp.minimum, x, y)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return _t(x).sum(axis=axis, keepdim=keepdim, dtype=dtype)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return _t(x).mean(axis=axis, keepdim=keepdim)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _t(x).max(axis=axis, keepdim=keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _t(x).min(axis=axis, keepdim=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, name=None):
+    return _t(x).prod(axis=axis, keepdim=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return _t(x).std(axis=axis, keepdim=keepdim, unbiased=unbiased)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return _t(x).var(axis=axis, keepdim=keepdim, unbiased=unbiased)
+
+
+def cumsum(x, axis=None, dtype=None):
+    return _t(x).cumsum(axis=axis)
+
+
+def cumprod(x, dim=None):
+    return _unary(lambda a: jnp.cumprod(a.reshape(-1) if dim is None else a, axis=0 if dim is None else dim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return _unary(lambda a: jax.nn.logsumexp(a, axis=axis, keepdims=keepdim), x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    return _t(x).argmax(axis=axis, keepdim=keepdim)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    return _t(x).argmin(axis=axis, keepdim=keepdim)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    x = _t(x)
+    if axis not in (-1, x.ndim - 1):
+        xm = x.transpose(_moved_perm(x.ndim, axis))
+        vals, idx = topk(xm, k, axis=-1, largest=largest)
+        inv = _moved_perm(x.ndim, axis)
+        return vals.transpose(inv), idx.transpose(inv)
+
+    def fn(a):
+        if largest:
+            v, i = jax.lax.top_k(a, k)
+        else:
+            v, i = jax.lax.top_k(-a, k)
+            v = -v
+        return v
+
+    vals = apply_op(fn, x)
+    arr = x._data
+    if largest:
+        _, idx = jax.lax.top_k(arr, k)
+    else:
+        _, idx = jax.lax.top_k(-arr, k)
+    return vals, Tensor._wrap(idx.astype(jnp.int64))
+
+
+def _moved_perm(ndim, axis):
+    axis = axis % ndim
+    perm = list(range(ndim))
+    perm[axis], perm[-1] = perm[-1], perm[axis]
+    return perm
+
+
+def sort(x, axis=-1, descending=False):
+    return _t(x).sort(axis=axis, descending=descending)
+
+
+def argsort(x, axis=-1, descending=False):
+    return _t(x).argsort(axis=axis, descending=descending)
+
+
+def isnan(x):
+    return _t(x).isnan()
+
+
+def isinf(x):
+    return _t(x).isinf()
+
+
+def isfinite(x):
+    return _t(x).isfinite()
+
+
+def equal(x, y):
+    return _t(x).equal(y)
+
+
+def not_equal(x, y):
+    return _t(x).not_equal(y)
+
+
+def greater_than(x, y):
+    return _t(x).greater_than(y)
+
+
+def greater_equal(x, y):
+    return _t(x).__ge__(y)
+
+
+def less_than(x, y):
+    return _t(x).less_than(y)
+
+
+def less_equal(x, y):
+    return _t(x).__le__(y)
+
+
+def logical_and(x, y):
+    return _t(x).logical_and(_t(y))
+
+
+def logical_or(x, y):
+    return _t(x).logical_or(_t(y))
+
+
+def logical_not(x):
+    return _t(x).logical_not()
+
+
+def logical_xor(x, y):
+    return Tensor._wrap(jnp.logical_xor(_t(x)._data, _t(y)._data))
+
+
+def all(x, axis=None, keepdim=False):
+    return _t(x).all(axis=axis, keepdim=keepdim)
+
+
+def any(x, axis=None, keepdim=False):
+    return _t(x).any(axis=axis, keepdim=keepdim)
+
+
+def where(condition, x=None, y=None):
+    cond = condition._data if isinstance(condition, Tensor) else jnp.asarray(condition)
+    if x is None and y is None:
+        return tuple(Tensor._wrap(i) for i in jnp.where(cond))
+    return apply_op(lambda a, b: jnp.where(cond, a, b), _t(x), _t(y))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    def fn(a):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out
+
+    return _unary(fn, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return _unary(lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def multiplex(inputs, index):
+    idx = index._data if isinstance(index, Tensor) else jnp.asarray(index)
+    stacked = jnp.stack([_t(i)._data for i in inputs])
+    return Tensor._wrap(jnp.take_along_axis(stacked, idx.reshape(1, -1, 1), axis=0)[0])
+
+
+def diff(x, n=1, axis=-1):
+    return _unary(lambda a: jnp.diff(a, n=n, axis=axis), x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return _unary(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def lerp(x, y, weight):
+    w = weight._data if isinstance(weight, Tensor) else weight
+    return apply_op(lambda a, b: a + w * (b - a), _t(x), _t(y))
+
+
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return Tensor._wrap(jnp.allclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return Tensor._wrap(jnp.isclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))

@@ -1,0 +1,10 @@
+"""Pallas TPU kernels — the fused-kernel layer (reference: SURVEY.md A3.x,
+paddle/phi/kernels/fusion/gpu + paddle/fluid/operators/fused).
+
+Kernels here are the hand-written hot path; everything else rides XLA fusion.
+Each kernel ships with a jnp reference implementation and OpTest-style
+numerics tests (tests/test_flash_attention.py etc.). On non-TPU backends the
+kernels run in Pallas interpret mode so CI (8 virtual CPU devices) covers
+them.
+"""
+from .flash_attention import flash_attention_fused

@@ -1,0 +1,292 @@
+"""Pallas flash attention (FlashAttention-2 style), fwd + bwd.
+
+Replaces the reference's external flash-attn CUDA library
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu + cmake/external/flashattn.cmake)
+with a TPU-native tiled online-softmax kernel:
+
+* fwd: grid (batch*heads, q_blocks, kv_blocks), kv innermost; VMEM scratch
+  carries running max m, normalizer l, and the output accumulator across the
+  kv loop; logits/accum in fp32 on the MXU (q/k/v may be bf16).
+* bwd: FlashAttention-2 recompute scheme — delta = rowsum(dO*O) precomputed
+  in XLA, then one kernel accumulating dK/dV over the q loop and one
+  accumulating dQ over the kv loop, both re-forming P from (q,k,lse).
+
+Layout: [B, S, H, D] (paddle flash_attention layout) is transposed to
+[B*H, S, D] outside the kernel. Tiles are 128×128 (MXU native); D must be a
+multiple of 128 lanes handled by padding at the wrapper level if needed.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1.0e30
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# --------------------------------------------------------------------- fwd
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
+                scale, causal, block_q, block_k, num_kv):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * scale  # [bq, bk]
+
+    if causal:
+        iq = pl.program_id(1)
+        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+
+    m_prev = m_s[:, :1]  # [bq, 1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
+    p = jnp.exp(s - m_new)  # [bq, bk]
+    l_new = alpha * l_s[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+
+    acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+        p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+    l_s[:] = jnp.broadcast_to(l_new, l_s.shape)
+
+    @pl.when(ik == num_kv - 1)
+    def _finish():
+        l = l_s[:, :1]
+        o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:] + jnp.log(jnp.maximum(l_s[:], 1e-37))).astype(jnp.float32)
+
+
+def _fwd(q, k, v, *, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    grid = (bh, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, num_kv=nk,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[:, :, :1]  # lse [bh, sq, 1]
+
+
+# --------------------------------------------------------------------- bwd
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                dk_s, dv_s, *, scale, causal, block_q, block_k, num_q):
+    iq = pl.program_id(2)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    if causal:
+        jk = pl.program_id(1)
+        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_ids = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
+    do = do_ref[0].astype(jnp.float32)
+    # dV += P^T @ dO
+    dv_s[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+    # dP = dO @ V^T ; dS = P * (dP - delta)
+    dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, :1])
+    # dK += dS^T @ Q * scale
+    dk_s[:] += jax.lax.dot_general(ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
+               scale, causal, block_q, block_k, num_kv):
+    ik = pl.program_id(2)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    q = q_ref[0]
+    k = k_ref[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    if causal:
+        iq = pl.program_id(1)
+        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, :1])
+    do = do_ref[0].astype(jnp.float32)
+    dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0][:, :1])
+    dq_s[:] += jax.lax.dot_general(ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    nq, nk = sq // block_q, sk // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)  # [bh, sq, 1]
+    lse_b = jnp.broadcast_to(lse, (bh, sq, 128))
+    delta_b = jnp.broadcast_to(delta, (bh, sq, 128))
+
+    dkv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_q=nq),
+        grid=(bh, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+    dk, dv = dkv
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, num_kv=nk),
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse_b, delta_b)
+    return dq, dk, dv
+
+
+# ------------------------------------------------------------------ public
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    return _bwd(scale, causal, block_q, block_k, res, do)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fused(q, k, v, causal=True, scale=None,
+                          block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention on [B, S, H, D] arrays (paddle layout). Returns same
+    layout. S must be a multiple of the block sizes; D padded to 128 lanes
+    internally when needed."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+
+    dpad = (128 - d % 128) % 128
+    # [B,S,H,D] -> [B*H, S, D]
+    def to_bh(x, s):
+        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+        if dpad:
+            x = jnp.pad(x, ((0, 0), (0, 0), (0, dpad)))
+        return x
+
+    qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
+    # padded-lane correction: zero q/k padding keeps logits exact
+    out = _flash_bhsd(qb, kb, vb, scale, causal, block_q, block_k)
+    if dpad:
+        out = out[..., :d]
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)

@@ -1,0 +1,6 @@
+"""Optimizers + LR schedulers (reference: python/paddle/optimizer/)."""
+from . import lr
+from .optimizer import SGD, Adagrad, Adam, AdamW, Lamb, Momentum, Optimizer, RMSProp
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "RMSProp",
+           "Lamb", "lr"]

@@ -1,0 +1,71 @@
+"""paddle.save / paddle.load parity (reference: python/paddle/framework/io.py —
+pickle protocol with per-tensor numpy buffers).
+
+Distributed sharded/async checkpointing lives in
+paddle_tpu.distributed.checkpoint (orbax/tensorstore-backed); this module is
+the single-process façade both share.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .framework.tensor import Tensor
+
+__all__ = ["save", "load"]
+
+
+class _TensorPickle:
+    """Placeholder written into the pickle stream for each Tensor."""
+
+    def __init__(self, array: np.ndarray):
+        self.array = array
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = np.asarray(obj.numpy())
+        # bfloat16 has no native numpy dtype outside ml_dtypes; keep it
+        return _TensorPickle(arr)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        packed = [_pack(v) for v in obj]
+        try:
+            return t(packed)
+        except TypeError:  # namedtuple
+            return t(*packed)
+    return obj
+
+
+def _unpack(obj, return_tensor=True):
+    if isinstance(obj, _TensorPickle):
+        return Tensor(obj.array) if return_tensor else obj.array
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_tensor) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        unpacked = [_unpack(v, return_tensor) for v in obj]
+        try:
+            return t(unpacked)
+        except TypeError:
+            return t(*unpacked)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_tensor=not return_numpy)

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Headline benchmark: GPT-2 causal-LM training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric is MFU of a fully-jitted train step (forward + backward + AdamW-style
+update, bf16 compute / fp32 master params) — the north-star metric class from
+BASELINE.md. MFU convention: 6*N*tokens_per_sec / peak_flops, model FLOPs
+(remat excluded), per-chip over per-chip. vs_baseline = MFU / 0.45 (the
+BASELINE.json target for the hybrid pod config; single-chip MFU is the
+round-1 proxy).
+"""
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+PEAK_BF16_FLOPS = {
+    # per-chip dense bf16 peak
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for key, val in sorted(PEAK_BF16_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(key):
+            return val
+    return 197e12  # conservative default (v5e)
+
+
+def main():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.jit import functional_call, param_arrays
+    from paddle_tpu.framework.tensor import Tensor
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                        max_position=1024, vocab_size=50304)
+        batch, seq, steps = 8, 1024, 20
+    else:  # CPU smoke mode so the script always runs
+        cfg = GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                        max_position=256, vocab_size=1024)
+        batch, seq, steps = 2, 128, 3
+
+    model = GPTForCausalLM(cfg)
+    model.eval()  # dropout off; loss path is what we time
+    params = param_arrays(model)
+
+    def loss_fn(params_bf16, ids, labels):
+        logits = functional_call(model, params_bf16, Tensor._wrap(ids))
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def train_step(params, opt_m, ids, labels):
+        p_bf16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
+        loss, grads = jax.value_and_grad(loss_fn)(p_bf16, ids, labels)
+        grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        new_m = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g, opt_m, grads)
+        new_p = jax.tree_util.tree_map(lambda p, m: p - 1e-4 * m, params, new_m)
+        return new_p, new_m, loss
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    opt_m = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), params)
+
+    # warmup (compile + first dispatch); device_get is the only reliable
+    # completion fence on the tunneled TPU backend in this image
+    # (block_until_ready can return before execution finishes there).
+    params, opt_m, loss = train_step(params, opt_m, ids, labels)
+    float(jax.device_get(loss))
+
+    # Chained dispatch: steps serialize on-device via the params dependency;
+    # the final fetch waits for the whole chain. One tunnel round-trip total.
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_m, loss = train_step(params, opt_m, ids, labels)
+    final_loss = float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = cfg.num_params()
+    model_flops_per_tok = 6 * n_params
+    # attention flops (not in 6N): 12 * L * H * S per token (fwd+bwd, causal/2)
+    attn_flops_per_tok = 12 * cfg.num_layers * cfg.hidden_size * seq // 2
+    peak = peak_flops(jax.devices()[0])
+    mfu = tokens_per_sec * (model_flops_per_tok + attn_flops_per_tok) / peak
+
+    out = {
+        "metric": "gpt2_small_train_mfu_1chip",
+        "value": round(float(mfu), 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(float(mfu) / 0.45, 4),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "device": getattr(jax.devices()[0], "device_kind", "unknown"),
+        "loss": final_loss,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -129,6 +129,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled_opts: set = set()
 
     def scale(self, loss):
         if not self._enable:
@@ -136,7 +137,7 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        if not self._enable:
+        if not self._enable or id(optimizer) in self._unscaled_opts:
             return
         inv = 1.0 / self._scale
         found = False
@@ -146,15 +147,19 @@ class GradScaler:
                 found = bool(found or not bool(jnp.all(jnp.isfinite(g))))
                 p.grad._data = g
         self._found_inf = found
+        self._unscaled_opts.add(id(optimizer))
 
     def step(self, optimizer):
         if not self._enable:
             optimizer.step()
             return
+        # idempotent per step: the unscale-then-clip-then-step pattern must
+        # not divide by the scale twice
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
         self.update()
+        self._unscaled_opts.discard(id(optimizer))
 
     def update(self):
         if not (self._enable and self._dynamic):

@@ -207,7 +207,7 @@ def _run_backward(root: "Tensor", grad):
                 for hook in t._grad_hooks:
                     res = hook(Tensor._wrap(g, stop_gradient=True))
                     if res is not None:
-                        g = res._data if isinstance(res, Tensor) else jnp.asarray(g)
+                        g = res._data if isinstance(res, Tensor) else jnp.asarray(res)
                 cts = cts[:k] + (g,) + cts[k + 1 :]
         in_grads = node.vjp_fn(cts if len(cts) > 1 else cts[0])
         node.out_grads = [None] * len(node.out_avals)  # release
@@ -405,9 +405,7 @@ class Tensor:
         return self
 
     # -- operators ------------------------------------------------------------
-    def _binop(self, other, fn, reverse=False):
-        if reverse:
-            return apply_op(lambda b, a=None: fn(a, b) if a is not None else None, other) if isinstance(other, Tensor) else apply_op(lambda a: fn(other, a), self)
+    def _binop(self, other, fn):
         if isinstance(other, Tensor):
             return apply_op(fn, self, other)
         return apply_op(lambda a: fn(a, other), self)
@@ -759,7 +757,9 @@ class Tensor:
 
     def unbind(self, axis=0):
         n = self._data.shape[axis]
-        return tuple(self.gather(jnp.array(i), axis=axis).squeeze(axis) for i in range(n))
+        return tuple(
+            apply_op(lambda a, i=i: jnp.take(a, i, axis=axis), self) for i in range(n)
+        )
 
 
 def _ax(axis):

@@ -1,0 +1,283 @@
+"""paddle_tpu.jit — the compiled path.
+
+Reference: python/paddle/jit/ (``@paddle.jit.to_static``, dy2static AST
+transforms, partial_program.py). The TPU-native design deletes the AST
+machinery entirely: JAX traces Python directly, so ``to_static`` is a thin
+veneer over ``jax.jit`` plus StableHLO export (SURVEY.md §3.4 "this entire
+stack is jax.jit(train_step)").
+
+The load-bearing primitive here is :func:`functional_call`: it runs a stateful
+``nn.Layer`` as a *pure function* of an explicit parameter/buffer dict, which
+is what lets a whole training step (forward + backward + optimizer) become one
+XLA program — erasing the per-op dygraph overhead the reference built
+InterpreterCore/CINN to escape.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, Parameter, pause_tape
+
+__all__ = [
+    "InputSpec",
+    "functional_call",
+    "state_arrays",
+    "param_arrays",
+    "buffer_arrays",
+    "to_static",
+    "save",
+    "load",
+    "TranslatedLayer",
+]
+
+
+class InputSpec:
+    """Shape/dtype declaration for a traced input (reference:
+    python/paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def to_shape_dtype_struct(self):
+        from ..framework import dtype as dtypes
+
+        dt = dtypes.convert_dtype(self.dtype)
+        shape = tuple(1 if (s is None or s == -1) else int(s) for s in self.shape)
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+
+# ------------------------------------------------------------------ state I/O
+
+
+def param_arrays(layer) -> Dict[str, jax.Array]:
+    """Trainable parameters of a Layer as a flat {name: jax.Array} dict."""
+    return {
+        name: p._data
+        for name, p in layer.named_parameters()
+        if getattr(p, "trainable", True)
+    }
+
+
+def buffer_arrays(layer) -> Dict[str, jax.Array]:
+    return {name: b._data for name, b in layer.named_buffers() if b is not None}
+
+
+def state_arrays(layer) -> Dict[str, jax.Array]:
+    out = param_arrays(layer)
+    out.update(buffer_arrays(layer))
+    return out
+
+
+def _named_state_tensors(layer) -> Dict[str, Tensor]:
+    out = {name: p for name, p in layer.named_parameters()}
+    out.update({name: b for name, b in layer.named_buffers() if b is not None})
+    return out
+
+
+def functional_call(
+    layer,
+    state: Dict[str, Any],
+    *args,
+    return_buffers: bool = False,
+    **kwargs,
+):
+    """Run ``layer.forward(*args)`` as a pure function of ``state``.
+
+    ``state`` maps structured names (as in ``named_parameters`` /
+    ``named_buffers``) to raw ``jax.Array``/tracers. Tensors' storage is
+    swapped in for the duration of the call with the autograd tape paused, so
+    jax-level AD (``jax.grad`` / ``jax.vjp``) differentiates straight through
+    the layer's Python forward. Always restores original storage afterwards.
+
+    With ``return_buffers=True`` also returns the post-call buffer values
+    (e.g. BatchNorm running stats updated during a training forward) as a
+    dict, for threading through a scan/jit step.
+    """
+    named = _named_state_tensors(layer)
+    saved: Dict[str, Any] = {}
+    try:
+        for name, arr in state.items():
+            t = named.get(name)
+            if t is None:
+                raise KeyError(
+                    f"functional_call: state key {name!r} not found in layer"
+                )
+            saved[name] = t._data
+            t._data = arr if not isinstance(arr, Tensor) else arr._data
+        with pause_tape():
+            out = layer(*args, **kwargs)
+        out = jax.tree_util.tree_map(
+            lambda x: x._data if isinstance(x, Tensor) else x,
+            out,
+            is_leaf=lambda x: isinstance(x, Tensor),
+        )
+        if return_buffers:
+            new_buffers = {
+                name: b._data
+                for name, b in layer.named_buffers()
+                if b is not None and name in state
+            }
+            return out, new_buffers
+        return out
+    finally:
+        for name, arr in saved.items():
+            named[name]._data = arr
+
+
+# ------------------------------------------------------------------ to_static
+
+
+class StaticFunction:
+    """Compiled wrapper produced by ``to_static`` (reference:
+    python/paddle/jit/dy2static/program_translator.py StaticFunction —
+    here the 'program' is a jax-jitted callable + optional exported artifact).
+    """
+
+    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None, full_graph=True):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._is_layer = hasattr(fn_or_layer, "forward") and hasattr(
+            fn_or_layer, "named_parameters"
+        )
+        self._jitted = None
+        self._exported = None
+
+    @property
+    def _layer(self):
+        return self._target if self._is_layer else None
+
+    def _build(self):
+        if self._jitted is not None:
+            return
+        if self._is_layer:
+            layer = self._target
+
+            @jax.jit
+            def run(state, *xs):
+                return functional_call(layer, state, *[Tensor._wrap(x) for x in xs])
+
+            self._jitted = run
+        else:
+            fn = self._target
+
+            @jax.jit
+            def run(*xs):
+                ts = [Tensor._wrap(x) for x in xs]
+                with pause_tape():
+                    out = fn(*ts)
+                return jax.tree_util.tree_map(
+                    lambda x: x._data if isinstance(x, Tensor) else x,
+                    out,
+                    is_leaf=lambda x: isinstance(x, Tensor),
+                )
+
+            self._jitted = run
+
+    def __call__(self, *args, **kwargs):
+        self._build()
+        xs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        if self._is_layer:
+            out = self._jitted(state_arrays(self._target), *xs)
+        else:
+            out = self._jitted(*xs)
+        return jax.tree_util.tree_map(Tensor._wrap, out)
+
+    # parity helpers
+    def concrete_program(self):
+        self._build()
+        return self._jitted
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, full_graph=True, **kwargs):
+    """``@paddle.jit.to_static`` parity. Wraps a function or Layer into a
+    compiled StaticFunction (jax.jit under the hood)."""
+    if function is None:
+        return functools.partial(
+            to_static, input_spec=input_spec, build_strategy=build_strategy,
+            full_graph=full_graph, **kwargs,
+        )
+    if hasattr(function, "forward") and hasattr(function, "named_parameters"):
+        return StaticFunction(function, input_spec=input_spec)
+    wrapper = StaticFunction(function, input_spec=input_spec)
+    functools.update_wrapper(wrapper, function, updated=[])
+    return wrapper
+
+
+# ------------------------------------------------------------------ save/load
+
+
+def save(layer, path: str, input_spec: Optional[Sequence[InputSpec]] = None, **config):
+    """``paddle.jit.save`` parity: export a Layer (or StaticFunction over one)
+    as a serialized StableHLO module + params (reference format: .pdmodel +
+    .pdiparams — here: .stablehlo.bin + .pdiparams pickle)."""
+    import pickle
+
+    from jax import export as jax_export
+
+    if isinstance(layer, StaticFunction):
+        layer = layer._target
+    if input_spec is None:
+        raise ValueError("paddle_tpu.jit.save requires input_spec")
+    structs = [
+        s.to_shape_dtype_struct() if isinstance(s, InputSpec) else s
+        for s in input_spec
+    ]
+    state = state_arrays(layer)
+
+    def run(state, *xs):
+        return functional_call(layer, state, *[Tensor._wrap(x) for x in xs])
+
+    state_structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state
+    )
+    exported = jax_export.export(jax.jit(run))(state_structs, *structs)
+    with open(path + ".stablehlo.bin", "wb") as f:
+        f.write(exported.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(
+            {k: np.asarray(jax.device_get(v)) for k, v in state.items()}, f
+        )
+
+
+class TranslatedLayer:
+    """Loaded inference artifact (reference: python/paddle/jit/translated_layer.py)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+        self._call = jax.jit(exported.call)
+
+    def __call__(self, *args):
+        xs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        out = self._call(self._state, *xs)
+        return jax.tree_util.tree_map(Tensor._wrap, out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self
+
+
+def load(path: str) -> TranslatedLayer:
+    import pickle
+
+    from jax import export as jax_export
+
+    with open(path + ".stablehlo.bin", "rb") as f:
+        exported = jax_export.deserialize(bytearray(f.read()))
+    with open(path + ".pdiparams", "rb") as f:
+        state = {k: jnp.asarray(v) for k, v in pickle.load(f).items()}
+    return TranslatedLayer(exported, state)

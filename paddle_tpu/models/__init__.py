@@ -1,0 +1,6 @@
+"""Model zoo (reference: PaddleNLP model families + python/paddle/vision/models).
+
+GPT is the flagship family — it is what the acceptance configs 3/4 train
+(GPT-2 TP decode, GPT-3 6.7B hybrid; see BASELINE.md).
+"""
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_medium, gpt3_6p7b  # noqa: F401

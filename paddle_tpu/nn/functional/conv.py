@@ -97,21 +97,50 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None, data_format="NCHW"):
+    """Transposed conv as an lhs-dilated regular conv (the grouped form
+    jax.lax.conv_transpose lacks). Paddle weight layout: [in_c, out_c/groups,
+    kh, kw]; out_hw = (in-1)*s - 2*p + d*(k-1) + output_padding + 1."""
     x, weight = amp_cast("conv2d", _t(x), _t(weight))
     s, d = _pair(stride), _pair(dilation)
     p = _pair(padding)
+    kh, kw = weight._data.shape[-2:]
+    if data_format == "NHWC":
+        in_hw = x._data.shape[1:3]
+    else:
+        in_hw = x._data.shape[2:4]
+    if output_size is not None:
+        osz = _pair(output_size)
+        op = tuple(
+            osz[i] - ((in_hw[i] - 1) * s[i] - 2 * p[i] + d[i] * ((kh, kw)[i] - 1) + 1)
+            for i in range(2)
+        )
+    else:
+        op = _pair(output_padding)
+    if any(o < 0 or o >= s[i] for i, o in enumerate(op)):
+        raise ValueError(
+            f"conv2d_transpose: invalid output_padding {op} for stride {s}"
+        )
 
     def fn(a, w):
-        # paddle transpose-conv weight layout: [in_c, out_c/groups, kh, kw]
-        return jax.lax.conv_transpose(
-            a, w, strides=s,
-            padding=[(p[0], p[0]), (p[1], p[1])],
-            rhs_dilation=d,
-            dimension_numbers=(data_format, "IOHW", data_format),
-            transpose_kernel=True,
+        i_c, ocg = w.shape[0], w.shape[1]
+        # [I, O/g, kh, kw] -> [O, I/g, kh, kw], spatially flipped (transposed
+        # conv correlates with the flipped kernel)
+        wg = w.reshape(groups, i_c // groups, ocg, kh, kw)
+        wg = jnp.flip(jnp.transpose(wg, (0, 2, 1, 3, 4)), axis=(-2, -1))
+        wk = wg.reshape(groups * ocg, i_c // groups, kh, kw)
+        pad = [
+            (d[0] * (kh - 1) - p[0], d[0] * (kh - 1) - p[0] + op[0]),
+            (d[1] * (kw - 1) - p[1], d[1] * (kw - 1) - p[1] + op[1]),
+        ]
+        return jax.lax.conv_general_dilated(
+            a, wk, window_strides=(1, 1), padding=pad,
+            lhs_dilation=s, rhs_dilation=d,
+            dimension_numbers=(data_format, "OIHW", data_format),
+            feature_group_count=groups,
         )
 
     out = apply_op(fn, x, weight)
     if bias is not None:
-        out = apply_op(lambda o, b: o + b.reshape(1, -1, 1, 1), out, _t(bias))
+        bshape = (1, 1, 1, -1) if data_format == "NHWC" else (1, -1, 1, 1)
+        out = apply_op(lambda o, b: o + b.reshape(bshape), out, _t(bias))
     return out

@@ -24,8 +24,12 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     """Returns output; updates running stats in-place on the passed tensors
     when training (matching paddle's mutable-buffer semantics)."""
     x = _t(x)
-    axes = (0, 2, 3) if x._data.ndim == 4 else ((0,) if x._data.ndim == 2 else (0, 2))
-    shape = [1, -1] + [1] * (x._data.ndim - 2)
+    nd = x._data.ndim
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NHC")
+    ch_axis = (nd - 1) if (channel_last and nd > 2) else 1
+    axes = tuple(i for i in range(nd) if i != ch_axis)
+    shape = [1] * nd
+    shape[ch_axis] = -1
     use_stats = (not training) if use_global_stats is None else use_global_stats
 
     if use_stats:
@@ -109,8 +113,11 @@ def rms_norm(x, weight=None, epsilon=1e-6, axis=-1):
 
 def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW"):
     x = _t(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC", "NHC")
 
     def fn(a, *wb):
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
         n, c = a.shape[:2]
         spatial = a.shape[2:]
         xf = a.astype(jnp.float32).reshape(n, num_groups, c // num_groups, *spatial)
@@ -121,7 +128,10 @@ def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format=
         shape = [1, c] + [1] * len(spatial)
         if len(wb) == 2:
             out = out * wb[0].astype(jnp.float32).reshape(shape) + wb[1].astype(jnp.float32).reshape(shape)
-        return out.astype(a.dtype)
+        out = out.astype(a.dtype)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
 
     args = [_t(p) for p in (weight, bias) if p is not None]
     return apply_op(fn, x, *args)

@@ -61,9 +61,16 @@ class Layer:
                 params.pop(name)
             else:
                 params[name] = value
+            # keep the instance __dict__ fast path coherent with _parameters
+            self.__dict__.pop(name, None)
+            if value is not None:
+                object.__setattr__(self, name, value)
             return
         elif buffers is not None and name in buffers:
-            buffers[name] = value if not isinstance(value, Tensor) else value
+            buffers[name] = value
+            self.__dict__.pop(name, None)
+            if value is not None:
+                object.__setattr__(self, name, value)
             return
         object.__setattr__(self, name, value)
 

@@ -37,8 +37,23 @@ def _interpret() -> bool:
 # --------------------------------------------------------------------- fwd
 
 
+def _mask_logits(s, *, causal, kv_valid, block_q, block_k, iq, ik):
+    """Apply causal and/or kv-padding validity masks. ``kv_valid`` is the
+    original (unpadded) kv length, or None when no padding was added."""
+    if not causal and kv_valid is None:
+        return s
+    k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    keep = jnp.ones(s.shape, jnp.bool_)
+    if causal:
+        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        keep = jnp.logical_and(keep, q_ids >= k_ids)
+    if kv_valid is not None:
+        keep = jnp.logical_and(keep, k_ids < kv_valid)
+    return jnp.where(keep, s, NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                scale, causal, block_q, block_k, num_kv):
+                scale, causal, kv_valid, block_q, block_k, num_kv):
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -52,12 +67,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     s = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
     ) * scale  # [bq, bk]
-
-    if causal:
-        iq = pl.program_id(1)
-        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=block_q,
+                     block_k=block_k, iq=pl.program_id(1), ik=ik)
 
     m_prev = m_s[:, :1]  # [bq, 1]
     m_cur = jnp.max(s, axis=-1, keepdims=True)
@@ -75,18 +86,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
 
     @pl.when(ik == num_kv - 1)
     def _finish():
-        l = l_s[:, :1]
+        # max-guard keeps padded q rows (l==0) finite; they are sliced off
+        # by the wrapper and their cotangents are zero in bwd
+        l = jnp.maximum(l_s[:, :1], 1e-37)
         o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
         lse_ref[0] = (m_s[:] + jnp.log(jnp.maximum(l_s[:], 1e-37))).astype(jnp.float32)
 
 
-def _fwd(q, k, v, *, scale, causal, block_q, block_k):
+def _fwd(q, k, v, *, scale, causal, kv_valid, block_q, block_k):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
     grid = (bh, nq, nk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, kv_valid=kv_valid,
         block_q=block_q, block_k=block_k, num_kv=nk,
     )
     out, lse = pl.pallas_call(
@@ -119,7 +132,7 @@ def _fwd(q, k, v, *, scale, causal, block_q, block_k):
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_s, dv_s, *, scale, causal, block_q, block_k, num_q):
+                dk_s, dv_s, *, scale, causal, kv_valid, block_q, block_k, num_q):
     iq = pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -131,11 +144,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     k = k_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale  # [bq, bk]
-    if causal:
-        jk = pl.program_id(1)
-        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_ids = jk * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=block_q,
+                     block_k=block_k, iq=iq, ik=pl.program_id(1))
     p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
     do = do_ref[0].astype(jnp.float32)
     # dV += P^T @ dO
@@ -156,7 +166,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
-               scale, causal, block_q, block_k, num_kv):
+               scale, causal, kv_valid, block_q, block_k, num_kv):
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -167,11 +177,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
     k = k_ref[0]
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * scale
-    if causal:
-        iq = pl.program_id(1)
-        q_ids = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        s = jnp.where(q_ids >= k_ids, s, NEG_INF)
+    s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=block_q,
+                     block_k=block_k, iq=pl.program_id(1), ik=ik)
     p = jnp.exp(s - lse_ref[0][:, :1])
     do = do_ref[0].astype(jnp.float32)
     dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32), (((1,), (1,)), ((), ())),
@@ -185,7 +192,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, res, do):
+def _bwd(scale, causal, kv_valid, block_q, block_k, res, do):
     q, k, v, out, lse = res
     bh, sq, d = q.shape
     sk = k.shape[1]
@@ -197,7 +204,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
 
     dkv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_q=nq),
+                          kv_valid=kv_valid, block_q=block_q, block_k=block_k,
+                          num_q=nq),
         grid=(bh, nk, nq),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
@@ -225,7 +233,8 @@ def _bwd(scale, causal, block_q, block_k, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, num_kv=nk),
+                          kv_valid=kv_valid, block_q=block_q, block_k=block_k,
+                          num_kv=nk),
         grid=(bh, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -246,19 +255,21 @@ def _bwd(scale, causal, block_q, block_k, res, do):
 # ------------------------------------------------------------------ public
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd(q, k, v, scale, causal, kv_valid, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale=scale, causal=causal, kv_valid=kv_valid,
+                  block_q=block_q, block_k=block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+def _flash_fwd_rule(q, k, v, scale, causal, kv_valid, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale=scale, causal=causal, kv_valid=kv_valid,
+                    block_q=block_q, block_k=block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
-    return _bwd(scale, causal, block_q, block_k, res, do)
+def _flash_bwd_rule(scale, causal, kv_valid, block_q, block_k, res, do):
+    return _bwd(scale, causal, kv_valid, block_q, block_k, res, do)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -267,26 +278,31 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention_fused(q, k, v, causal=True, scale=None,
                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """Flash attention on [B, S, H, D] arrays (paddle layout). Returns same
-    layout. S must be a multiple of the block sizes; D padded to 128 lanes
-    internally when needed."""
+    layout. Seq lens and head dim are padded to tile multiples internally;
+    padded kv positions are masked in-kernel, padded q rows sliced off."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
+    def _up8(n):
+        return ((n + 7) // 8) * 8
+
+    block_q = min(block_q, _up8(sq))
+    block_k = min(block_k, _up8(sk))
+    qpad = (block_q - sq % block_q) % block_q
+    kpad = (block_k - sk % block_k) % block_k
+    kv_valid = sk if kpad else None
 
     dpad = (128 - d % 128) % 128
-    # [B,S,H,D] -> [B*H, S, D]
-    def to_bh(x, s):
+    # [B,S,H,D] -> [B*H, S, D], zero-padded to tile multiples
+    def to_bh(x, s, spad):
         x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
-        if dpad:
-            x = jnp.pad(x, ((0, 0), (0, 0), (0, dpad)))
+        if spad or dpad:
+            x = jnp.pad(x, ((0, 0), (0, spad), (0, dpad)))
         return x
 
-    qb, kb, vb = to_bh(q, sq), to_bh(k, sk), to_bh(v, sk)
-    # padded-lane correction: zero q/k padding keeps logits exact
-    out = _flash_bhsd(qb, kb, vb, scale, causal, block_q, block_k)
-    if dpad:
-        out = out[..., :d]
+    qb, kb, vb = to_bh(q, sq, qpad), to_bh(k, sk, kpad), to_bh(v, sk, kpad)
+    out = _flash_bhsd(qb, kb, vb, scale, causal, kv_valid, block_q, block_k)
+    if qpad or dpad:
+        out = out[:, :sq, :d]
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)

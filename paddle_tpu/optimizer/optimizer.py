@@ -255,7 +255,7 @@ class Adam(Optimizer):
         g = g + wd * p
         m = self._beta1 * state["moment1"] + (1 - self._beta1) * g
         v = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
-        stepf = step.astype(jnp.float32)
+        stepf = step.astype(jnp.float32) if hasattr(step, "astype") else jnp.float32(step)
         mhat = m / (1 - self._beta1**stepf)
         vhat = v / (1 - self._beta2**stepf)
         new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._eps)

@@ -1,0 +1,32 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+SURVEY.md §4.5: ``--xla_force_host_platform_device_count=8`` gives 8 fake
+devices in one process — the cheap analogue of the reference's subprocess
+spawn harness (test/legacy_test/test_dist_base.py) for mesh/sharding logic.
+Must be set before jax initializes its backends, hence in conftest at import
+time.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+# The hosted-TPU plugin in this image registers itself regardless of
+# JAX_PLATFORMS in the environment; the in-process config update is what
+# actually pins the test run to the virtual CPU devices.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,136 @@
+"""nn.Layer system + layer numerics (reference patterns:
+test/legacy_test/test_layers.py, test_layer_norm_op.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, grad=False):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=not grad)
+
+
+class TestLayerSystem:
+    def test_parameters_and_naming(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        params = net.parameters()
+        assert len(params) == 4  # 2 weights + 2 biases
+        names = [n for n, _ in net.named_parameters()]
+        assert any("weight" in n for n in names)
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Linear(4, 3)
+        sd = net.state_dict()
+        net2 = nn.Linear(4, 3)
+        net2.set_state_dict(sd)
+        x = t(np.random.randn(2, 4))
+        np.testing.assert_allclose(net(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+    def test_sublayers_train_eval(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net.training
+        x = t(np.ones((4, 2)))
+        np.testing.assert_allclose(net[1](x).numpy(), np.ones((4, 2)))
+        net.train()
+        assert net.training
+
+    def test_apply_and_children(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Linear(2, 2))
+        count = []
+        net.apply(lambda m: count.append(type(m).__name__))
+        assert "Linear" in count
+
+    def test_forward_hooks(self):
+        lin = nn.Linear(2, 2)
+        seen = []
+        h = lin.register_forward_post_hook(lambda layer, inp, out: seen.append(out.shape))
+        lin(t(np.ones((1, 2))))
+        assert seen == [[1, 2]]
+        h.remove()
+        lin(t(np.ones((1, 2))))
+        assert len(seen) == 1
+
+
+class TestLayerNumerics:
+    def test_linear_matches_numpy(self, rng):
+        lin = nn.Linear(4, 3)
+        x = rng.standard_normal((5, 4)).astype(np.float32)
+        w = lin.weight.numpy()
+        b = lin.bias.numpy()
+        np.testing.assert_allclose(lin(t(x)).numpy(), x @ w + b, rtol=1e-5)
+
+    def test_layernorm_matches_numpy(self, rng):
+        ln = nn.LayerNorm(8)
+        x = rng.standard_normal((3, 8)).astype(np.float32)
+        mu = x.mean(-1, keepdims=True)
+        var = x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5)
+        np.testing.assert_allclose(ln(t(x)).numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_against_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        conv = nn.Conv2D(3, 6, 3, stride=2, padding=1)
+        x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+        out = conv(t(x)).numpy()
+        tw = torch.tensor(conv.weight.numpy())
+        tb = torch.tensor(conv.bias.numpy())
+        ref = torch.nn.functional.conv2d(
+            torch.tensor(x), tw, tb, stride=2, padding=1
+        ).numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_train_updates_stats(self, rng):
+        bn = nn.BatchNorm2D(3)
+        x = rng.standard_normal((4, 3, 5, 5)).astype(np.float32) * 2 + 1
+        bn.train()
+        y = bn(t(x))
+        # after one train step running mean moved toward batch mean
+        assert not np.allclose(bn._mean.numpy(), np.zeros(3))
+        # normalized output ~ zero mean unit var per channel
+        yn = y.numpy()
+        np.testing.assert_allclose(yn.mean(axis=(0, 2, 3)), np.zeros(3), atol=1e-4)
+
+    def test_embedding(self, rng):
+        emb = nn.Embedding(10, 4)
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 4]], dtype=np.int64))
+        out = emb(ids)
+        assert out.shape == [2, 2, 4]
+        np.testing.assert_allclose(out.numpy()[0, 0], emb.weight.numpy()[1], rtol=1e-6)
+
+    def test_cross_entropy_matches_torch(self, rng):
+        torch = pytest.importorskip("torch")
+        logits = rng.standard_normal((6, 10)).astype(np.float32)
+        labels = rng.integers(0, 10, (6,))
+        ours = F.cross_entropy(
+            t(logits), paddle.to_tensor(labels.astype(np.int64))
+        ).numpy()
+        ref = torch.nn.functional.cross_entropy(
+            torch.tensor(logits), torch.tensor(labels.astype(np.int64))
+        ).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_multihead_attention_shapes(self, rng):
+        mha = nn.MultiHeadAttention(embed_dim=16, num_heads=4)
+        x = t(rng.standard_normal((2, 5, 16)))
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer_encoder(self, rng):
+        layer = nn.TransformerEncoderLayer(
+            d_model=16, nhead=4, dim_feedforward=32, dropout=0.0
+        )
+        enc = nn.TransformerEncoder(layer, num_layers=2)
+        x = t(rng.standard_normal((2, 5, 16)))
+        out = enc(x)
+        assert out.shape == [2, 5, 16]
+
+    def test_backward_through_net(self, rng):
+        net = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 1))
+        x = t(rng.standard_normal((3, 4)))
+        loss = net(x).sum()
+        loss.backward()
+        for p in net.parameters():
+            assert p.grad is not None, p.name

@@ -1,0 +1,90 @@
+"""Optimizer + LR scheduler tests (reference: test/legacy_test/test_adamw_op.py,
+test_lr_scheduler.py patterns — convergence + analytic single-step checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def _converges(opt_cls, lr=0.1, steps=120, **kw):
+    # minimize ||w - target||^2
+    target = np.array([1.0, -2.0, 3.0], dtype=np.float32)
+    w = paddle.framework.Parameter(np.zeros(3, dtype=np.float32))
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(w.numpy() - target).max()
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        assert _converges(optimizer.SGD, lr=0.1) < 1e-3
+
+    def test_momentum(self):
+        assert _converges(optimizer.Momentum, lr=0.05, steps=250) < 1e-3
+
+    def test_adam(self):
+        assert _converges(optimizer.Adam, lr=0.2) < 1e-2
+
+    def test_adamw(self):
+        assert _converges(optimizer.AdamW, lr=0.2, weight_decay=0.0) < 1e-2
+
+    def test_adamw_decoupled_decay(self):
+        # pure decay with zero grad: w <- w - lr*wd*w per step
+        w = paddle.framework.Parameter(np.ones(2, dtype=np.float32))
+        opt = optimizer.AdamW(learning_rate=0.1, parameters=[w], weight_decay=0.5)
+        (w * 0.0).sum().backward()
+        opt.step()
+        np.testing.assert_allclose(w.numpy(), np.full(2, 0.95), rtol=1e-5)
+
+    def test_clip_grad_by_global_norm(self):
+        w = paddle.framework.Parameter(np.zeros(4, dtype=np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+        (w * paddle.to_tensor(np.full(4, 10.0, np.float32))).sum().backward()
+        opt.step()
+        # grad was [10]*4, norm 20 -> clipped to norm 1
+        np.testing.assert_allclose(np.linalg.norm(w.numpy()), 1.0, rtol=1e-4)
+
+    def test_optimizer_state_dict_roundtrip(self):
+        w = paddle.framework.Parameter(np.zeros(3, dtype=np.float32), name="w0")
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        (w**2).sum().backward()
+        opt.step()
+        sd = opt.state_dict()
+        w2 = paddle.framework.Parameter(np.zeros(3, dtype=np.float32), name="w0")
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w2])
+        opt2.set_state_dict(sd)
+        assert opt2.state_dict().keys() == sd.keys()
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        vals = []
+        for _ in range(4):
+            vals.append(sched())
+            sched.step()
+        np.testing.assert_allclose(vals, [0.1, 0.1, 0.05, 0.05], rtol=1e-6)
+
+    def test_warmup(self):
+        base = optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        sched = optimizer.lr.LinearWarmup(
+            learning_rate=base, warmup_steps=5, start_lr=0.0, end_lr=1.0
+        )
+        v0 = sched()
+        sched.step()
+        v1 = sched()
+        assert v0 == 0.0 and 0 < v1 <= 0.25
+
+    def test_scheduler_drives_optimizer(self):
+        sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=1, gamma=0.1)
+        w = paddle.framework.Parameter(np.zeros(1, dtype=np.float32))
+        opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert abs(opt.get_lr() - 0.1) < 1e-8
+        sched.step()
+        assert abs(opt.get_lr() - 0.01) < 1e-8

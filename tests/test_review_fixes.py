@@ -1,0 +1,105 @@
+"""Regression tests for review findings (round 1 code review)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+
+
+def t(a, grad=False):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=not grad)
+
+
+def test_gradscaler_unscale_then_step_not_double_unscaled():
+    w = paddle.framework.Parameter(np.ones(2, dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**10)
+    loss = (w * 4.0).sum()
+    scaler.scale(loss).backward()
+    scaler.unscale_(opt)  # documented unscale-then-clip-then-step pattern
+    g_after_unscale = w.grad.numpy().copy()
+    np.testing.assert_allclose(g_after_unscale, [4.0, 4.0])
+    scaler.step(opt)  # must NOT divide by scale again
+    np.testing.assert_allclose(w.numpy(), [1.0 - 4.0, 1.0 - 4.0])
+
+
+def test_gradscaler_step_unscales_once_when_not_preunscaled():
+    w = paddle.framework.Parameter(np.ones(2, dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**10)
+    for _ in range(2):  # second iteration checks per-step reset
+        loss = (w * 4.0).sum()
+        scaler.scale(loss).backward()
+        before = w.numpy().copy()
+        scaler.step(opt)
+        opt.clear_grad()
+        np.testing.assert_allclose(w.numpy(), before - 4.0)
+
+
+def test_batchnorm_nhwc(rng):
+    x = rng.standard_normal((4, 5, 5, 3)).astype(np.float32) * 2 + 1
+    rm = paddle.to_tensor(np.zeros(3, np.float32))
+    rv = paddle.to_tensor(np.ones(3, np.float32))
+    out = F.batch_norm(t(x), rm, rv, training=True, data_format="NHWC")
+    yn = out.numpy()
+    np.testing.assert_allclose(yn.mean(axis=(0, 1, 2)), np.zeros(3), atol=1e-4)
+    np.testing.assert_allclose(yn.var(axis=(0, 1, 2)), np.ones(3), atol=1e-3)
+
+
+def test_groupnorm_nhwc(rng):
+    x = rng.standard_normal((2, 4, 4, 6)).astype(np.float32)
+    out_last = F.group_norm(t(x), num_groups=2, data_format="NHWC").numpy()
+    out_first = F.group_norm(
+        t(np.moveaxis(x, -1, 1)), num_groups=2, data_format="NCHW"
+    ).numpy()
+    np.testing.assert_allclose(out_last, np.moveaxis(out_first, 1, -1), rtol=1e-5, atol=1e-5)
+
+
+def test_intermediate_hook_returning_array():
+    x = t([1.0, 1.0], grad=True)
+    y = x * 3.0
+    y.register_hook(lambda g: g.numpy() * 0.5)  # non-Tensor return
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.5, 1.5])
+
+
+def test_conv2d_transpose_groups_and_output_padding(rng):
+    torch = pytest.importorskip("torch")
+    x = rng.standard_normal((2, 4, 5, 5)).astype(np.float32)
+    w = rng.standard_normal((4, 3, 3, 3)).astype(np.float32)  # [I, O/g, kh, kw], g=2
+    ours = F.conv2d_transpose(
+        t(x), t(w), stride=2, padding=1, output_padding=1, groups=2
+    ).numpy()
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1, output_padding=1, groups=2
+    ).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_transpose_output_size(rng):
+    x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+    w = rng.standard_normal((2, 2, 3, 3)).astype(np.float32)
+    out = F.conv2d_transpose(t(x), t(w), stride=2, padding=1, output_size=8)
+    assert out.shape == [1, 2, 8, 8]
+
+
+def test_adam_plain_int_step_in_tree_api():
+    opt = optimizer.Adam(learning_rate=0.1)
+    import jax.numpy as jnp
+
+    params = {"w": jnp.ones(2)}
+    grads = {"w": jnp.ones(2)}
+    state = {"w": opt.init_state(params["w"])}
+    new_p, _ = opt.apply_gradients_tree(params, grads, state, lr=0.1, step=10)
+    assert np.all(np.isfinite(np.asarray(new_p["w"])))
+
+
+def test_layer_param_reassignment_consistent():
+    lin = nn.Linear(2, 2)
+    new_w = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    lin.weight = new_w  # non-Parameter assignment over a parameter name
+    # attribute access and forward must both see the new value
+    np.testing.assert_allclose(lin.weight.numpy(), np.zeros((2, 2)))
+    out = lin(t(np.ones((1, 2))))
+    np.testing.assert_allclose(out.numpy(), lin.bias.numpy()[None, :], rtol=1e-6)

@@ -1,0 +1,150 @@
+"""Tensor facade + tape autograd tests.
+
+Models the reference's OpTest pattern (test/legacy_test/op_test.py): forward
+against a numpy reference, backward against analytic/numeric gradients.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, grad=False):
+    return paddle.to_tensor(np.asarray(a, dtype=np.float32), stop_gradient=not grad)
+
+
+class TestBasics:
+    def test_creation_and_meta(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.shape == [2, 2]
+        assert x.ndim == 2
+        assert x.size == 4
+        np.testing.assert_allclose(x.numpy(), [[1, 2], [3, 4]])
+
+    def test_arith_matches_numpy(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((3, 4)).astype(np.float32)
+        x, y = t(a), t(b)
+        np.testing.assert_allclose((x + y).numpy(), a + b, rtol=1e-6)
+        np.testing.assert_allclose((x * y).numpy(), a * b, rtol=1e-6)
+        np.testing.assert_allclose((x / (y.abs() + 1)).numpy(), a / (np.abs(b) + 1), rtol=1e-5)
+        np.testing.assert_allclose((x - 2.5).numpy(), a - 2.5, rtol=1e-6)
+        np.testing.assert_allclose((2.5 - x).numpy(), 2.5 - a, rtol=1e-6)
+        np.testing.assert_allclose((-x).numpy(), -a)
+
+    def test_matmul_reductions(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 5)).astype(np.float32)
+        np.testing.assert_allclose((t(a) @ t(b)).numpy(), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(t(a).sum(axis=1).numpy(), a.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(t(a).mean().numpy(), a.mean(), rtol=1e-5)
+        np.testing.assert_allclose(t(a).max(axis=0).numpy(), a.max(0))
+
+    def test_shape_ops(self, rng):
+        a = rng.standard_normal((2, 3, 4)).astype(np.float32)
+        x = t(a)
+        assert x.reshape([6, 4]).shape == [6, 4]
+        assert x.transpose([2, 0, 1]).shape == [4, 2, 3]
+        assert x.flatten().shape == [24]
+        assert x.unsqueeze(0).shape == [1, 2, 3, 4]
+        assert x[0].shape == [3, 4]
+        assert x[:, 1].shape == [2, 4]
+
+    def test_astype(self):
+        x = t([1.5, 2.5])
+        assert str(x.astype("int32").dtype) == "int32"
+        assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+
+
+class TestAutograd:
+    def test_chain_rule(self):
+        x = t([2.0], grad=True)
+        y = (x * x * 3.0 + x).sum()
+        y.backward()
+        # d/dx (3x^2 + x) = 6x + 1 = 13
+        np.testing.assert_allclose(x.grad.numpy(), [13.0], rtol=1e-6)
+
+    def test_matmul_grad(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((4, 5)).astype(np.float32)
+        x, w = t(a, grad=True), t(b, grad=True)
+        (x @ w).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), (np.ones((3, 5)) @ b.T), rtol=1e-5)
+        np.testing.assert_allclose(w.grad.numpy(), (a.T @ np.ones((3, 5))), rtol=1e-5)
+
+    def test_grad_accumulation(self):
+        x = t([1.0, 2.0], grad=True)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_shared_subexpression(self):
+        # same tensor used twice — grads must sum
+        x = t([3.0], grad=True)
+        y = x * x  # dy/dx = 2x
+        (y + y).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+    def test_no_grad(self):
+        x = t([1.0], grad=True)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_detach(self):
+        x = t([1.0], grad=True)
+        d = x.detach()
+        assert d.stop_gradient
+        np.testing.assert_allclose(d.numpy(), [1.0])
+
+    def test_register_hook_scales_grad(self):
+        x = t([1.0, 1.0], grad=True)
+        x.register_hook(lambda g: g * 2)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+    def test_paddle_grad_api(self):
+        x = t([2.0], grad=True)
+        y = (x ** 3).sum()
+        (g,) = paddle.grad(y, [x])
+        np.testing.assert_allclose(g.numpy(), [12.0], rtol=1e-6)
+
+    def test_nondiff_int_tensor_skipped(self):
+        idx = paddle.to_tensor(np.array([0, 1], dtype=np.int32))
+        x = t([[1.0, 2.0], [3.0, 4.0]], grad=True)
+        y = x.gather(idx, axis=0).sum()
+        y.backward()
+        assert x.grad is not None
+
+    def test_broadcast_grad(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((4,)).astype(np.float32)
+        x, y = t(a, grad=True), t(b, grad=True)
+        (x + y).sum().backward()
+        np.testing.assert_allclose(y.grad.numpy(), np.full(4, 3.0), rtol=1e-6)
+
+
+class TestOpsModule:
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.ones([2]).numpy().tolist() == [1.0, 1.0]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        assert paddle.full([2, 2], 7.0).numpy().tolist() == [[7.0, 7.0], [7.0, 7.0]]
+
+    def test_concat_stack_split(self, rng):
+        a = rng.standard_normal((2, 3)).astype(np.float32)
+        x = t(a)
+        c = paddle.concat([x, x], axis=0)
+        assert c.shape == [4, 3]
+        s = paddle.stack([x, x], axis=0)
+        assert s.shape == [2, 2, 3]
+        parts = paddle.split(c, 2, axis=0)
+        assert len(parts) == 2 and parts[0].shape == [2, 3]
+
+    def test_where_softmax(self, rng):
+        a = rng.standard_normal((2, 5)).astype(np.float32)
+        sm = paddle.nn.functional.softmax(t(a), axis=-1).numpy()
+        e = np.exp(a - a.max(-1, keepdims=True))
+        np.testing.assert_allclose(sm, e / e.sum(-1, keepdims=True), rtol=1e-5)

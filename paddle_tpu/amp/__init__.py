@@ -159,9 +159,11 @@ class GradScaler:
         if not self._found_inf:
             optimizer.step()
         self.update()
-        self._unscaled_opts.discard(id(optimizer))
 
     def update(self):
+        # end of iteration for every pattern (scaler.step or manual
+        # unscale/opt.step/update) — re-arm unscaling
+        self._unscaled_opts.clear()
         if not (self._enable and self._dynamic):
             return
         if self._found_inf:

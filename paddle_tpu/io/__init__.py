@@ -1,0 +1,27 @@
+"""paddle_tpu.io — datasets + DataLoader (reference: python/paddle/io/).
+
+TPU-native data path (SURVEY.md B6): the reference's multiprocess worker pool
++ pinned-memory thread feeding a GPU stream becomes a host-side worker pool
+feeding ``jax.device_put`` with double buffering — device transfer overlaps
+host batch assembly, which is what hides input latency on TPU (there is no
+"pin memory"; PJRT handles the HBM staging).
+"""
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

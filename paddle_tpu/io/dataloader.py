@@ -1,0 +1,159 @@
+"""DataLoader (reference: python/paddle/io/dataloader/dataloader_iter.py).
+
+Host pipeline: sample indices → worker pool assembles numpy batches →
+bounded prefetch queue → ``jax.device_put`` double-buffering. Divergence from
+the reference, by design: workers are *threads*, not forked processes — the
+numpy/PIL work they do releases the GIL, fork is hostile to a live PJRT
+client, and the transfer overlap (the thing the reference's pin-memory thread
+buys) comes from device_put being async.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched arrays (reference:
+    python/paddle/io/dataloader/collate.py default_collate_fn)."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return tuple(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    from ..framework.tensor import Tensor
+
+    if isinstance(sample, Tensor):
+        return np.stack([t.numpy() for t in batch])
+    return np.asarray(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler: Optional[BatchSampler] = None,
+                 batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn: Optional[Callable] = None, num_workers=0,
+                 use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False, to_device=True):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, int(num_workers))
+        self.prefetch_factor = max(1, int(prefetch_factor))
+        self.worker_init_fn = worker_init_fn
+        self.to_device = to_device
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size, drop_last=drop_last
+            )
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------------------------ iter
+    def _batches_np(self):
+        """Yield collated numpy batches (worker-pool or inline)."""
+        if self._iterable:
+            buf = []
+            for sample in self.dataset:
+                buf.append(sample)
+                if len(buf) == self.batch_size:
+                    yield self.collate_fn(buf)
+                    buf = []
+            if buf and not self.drop_last:
+                yield self.collate_fn(buf)
+            return
+
+        index_iter = iter(self.batch_sampler)
+        if self.num_workers == 0:
+            for idxs in index_iter:
+                yield self.collate_fn([self.dataset[i] for i in idxs])
+            return
+
+        # thread workers: fetch batches concurrently, deliver in order
+        out_q: "queue.Queue" = queue.Queue(maxsize=self.num_workers * self.prefetch_factor)
+        batches = list(index_iter)
+        n = len(batches)
+        results = {}
+        lock = threading.Lock()
+        next_fetch = [0]
+        stop = threading.Event()
+
+        def worker(wid):
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                with lock:
+                    i = next_fetch[0]
+                    if i >= n:
+                        return
+                    next_fetch[0] = i + 1
+                try:
+                    data = self.collate_fn([self.dataset[j] for j in batches[i]])
+                    out_q.put((i, data))
+                except Exception as e:  # surface in consumer
+                    out_q.put((i, e))
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(self.num_workers)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            want = 0
+            while want < n:
+                while want not in results:
+                    i, data = out_q.get()
+                    results[i] = data
+                data = results.pop(want)
+                if isinstance(data, Exception):
+                    raise data
+                yield data
+                want += 1
+        finally:
+            stop.set()
+
+    def __iter__(self):
+        from ..framework.tensor import Tensor
+        import jax
+
+        def to_tensors(batch):
+            if isinstance(batch, (tuple, list)):
+                return [to_tensors(b) for b in batch]
+            if isinstance(batch, dict):
+                return {k: to_tensors(v) for k, v in batch.items()}
+            if self.to_device:
+                return Tensor._wrap(jax.device_put(batch))
+            return Tensor._wrap(batch)
+
+        # double buffer: device transfer of batch i+1 overlaps consumption of i
+        prev = None
+        for np_batch in self._batches_np():
+            cur = to_tensors(np_batch)
+            if prev is not None:
+                yield prev
+            prev = cur
+        if prev is not None:
+            yield prev

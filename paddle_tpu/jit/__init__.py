@@ -45,11 +45,28 @@ class InputSpec:
         self.dtype = dtype
         self.name = name
 
-    def to_shape_dtype_struct(self):
+    def has_dynamic_dims(self):
+        return any(s is None or s == -1 for s in self.shape)
+
+    def to_shape_dtype_struct(self, scope=None):
+        """Concrete or symbolic ShapeDtypeStruct. Dynamic dims (None / -1)
+        become export symbols (shared ``scope`` keeps symbols consistent
+        across multiple specs) so jit.save exports a dynamic-batch module
+        instead of silently narrowing to batch 1."""
         from ..framework import dtype as dtypes
 
         dt = dtypes.convert_dtype(self.dtype)
-        shape = tuple(1 if (s is None or s == -1) else int(s) for s in self.shape)
+        if not self.has_dynamic_dims():
+            return jax.ShapeDtypeStruct(tuple(int(s) for s in self.shape), dt)
+        from jax import export as jax_export
+
+        if scope is None:
+            scope = jax_export.SymbolicScope()
+        dims = ",".join(
+            f"_dyn{i}" if (s is None or s == -1) else str(int(s))
+            for i, s in enumerate(self.shape)
+        )
+        shape = jax_export.symbolic_shape(dims, scope=scope)
         return jax.ShapeDtypeStruct(shape, dt)
 
     def __repr__(self):
@@ -149,53 +166,84 @@ class StaticFunction:
         self._is_layer = hasattr(fn_or_layer, "forward") and hasattr(
             fn_or_layer, "named_parameters"
         )
-        self._jitted = None
+        self._jit_cache = None
         self._exported = None
 
     @property
     def _layer(self):
         return self._target if self._is_layer else None
 
-    def _build(self):
-        if self._jitted is not None:
-            return
+    def _get_jitted(self, static_kw: tuple):
+        """One compiled program per static-kwarg combination (the analogue of
+        the reference's program cache keyed on input spec,
+        python/paddle/jit/dy2static/program_translator.py)."""
+        if self._jit_cache is None:
+            self._jit_cache = {}
+        if static_kw in self._jit_cache:
+            return self._jit_cache[static_kw]
+        skw = dict(static_kw)
         if self._is_layer:
             layer = self._target
 
             @jax.jit
-            def run(state, *xs):
-                return functional_call(layer, state, *[Tensor._wrap(x) for x in xs])
+            def run(state, xs, kw):
+                xs = jax.tree_util.tree_map(Tensor._wrap, list(xs))
+                kw = jax.tree_util.tree_map(Tensor._wrap, kw)
+                # thread buffer mutations (BatchNorm running stats, ...)
+                # back out so the compiled path matches eager semantics
+                out, new_bufs = functional_call(
+                    layer, state, *xs, return_buffers=True, **kw, **skw
+                )
+                return out, new_bufs
 
-            self._jitted = run
         else:
             fn = self._target
 
             @jax.jit
-            def run(*xs):
-                ts = [Tensor._wrap(x) for x in xs]
+            def run(xs, kw):
+                ts = jax.tree_util.tree_map(Tensor._wrap, list(xs))
+                kws = jax.tree_util.tree_map(Tensor._wrap, kw)
                 with pause_tape():
-                    out = fn(*ts)
+                    out = fn(*ts, **kws, **skw)
                 return jax.tree_util.tree_map(
                     lambda x: x._data if isinstance(x, Tensor) else x,
                     out,
                     is_leaf=lambda x: isinstance(x, Tensor),
                 )
 
-            self._jitted = run
+        self._jit_cache[static_kw] = run
+        return run
 
     def __call__(self, *args, **kwargs):
-        self._build()
-        xs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+        def unwrap(a):
+            return a._data if isinstance(a, Tensor) else a
+
+        def is_dynamic(v):
+            return isinstance(v, (Tensor, jax.Array, np.ndarray))
+
+        xs = tuple(
+            jax.tree_util.tree_map(unwrap, a, is_leaf=lambda x: isinstance(x, Tensor))
+            for a in args
+        )
+        dyn_kw = {k: unwrap(v) for k, v in kwargs.items() if is_dynamic(v)}
+        static_kw = tuple(sorted(
+            (k, v) for k, v in kwargs.items() if not is_dynamic(v)
+        ))
+        jitted = self._get_jitted(static_kw)
         if self._is_layer:
-            out = self._jitted(state_arrays(self._target), *xs)
+            layer = self._target
+            out, new_bufs = jitted(state_arrays(layer), xs, dyn_kw)
+            named = dict(layer.named_buffers())
+            for name, arr in new_bufs.items():
+                if name in named and named[name] is not None:
+                    named[name]._data = arr
         else:
-            out = self._jitted(*xs)
+            out = jitted(xs, dyn_kw)
         return jax.tree_util.tree_map(Tensor._wrap, out)
 
     # parity helpers
     def concrete_program(self):
-        self._build()
-        return self._jitted
+        return self._get_jitted(())
 
 
 def to_static(function=None, input_spec=None, build_strategy=None, full_graph=True, **kwargs):
@@ -228,8 +276,15 @@ def save(layer, path: str, input_spec: Optional[Sequence[InputSpec]] = None, **c
         layer = layer._target
     if input_spec is None:
         raise ValueError("paddle_tpu.jit.save requires input_spec")
+    from jax import export as jax_export
+
+    scope = (
+        jax_export.SymbolicScope()
+        if any(isinstance(s, InputSpec) and s.has_dynamic_dims() for s in input_spec)
+        else None
+    )
     structs = [
-        s.to_shape_dtype_struct() if isinstance(s, InputSpec) else s
+        s.to_shape_dtype_struct(scope) if isinstance(s, InputSpec) else s
         for s in input_spec
     ]
     state = state_arrays(layer)

@@ -82,12 +82,18 @@ class Layer:
         raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
 
     def __delattr__(self, name):
+        found = False
         for store in ("_parameters", "_buffers", "_sub_layers"):
             d = self.__dict__.get(store)
             if d is not None and name in d:
                 del d[name]
-                return
-        object.__delattr__(self, name)
+                found = True
+        # the instance __dict__ fast-path copy must go too, else the
+        # attribute stays reachable after deletion
+        if name in self.__dict__:
+            object.__delattr__(self, name)
+        elif not found:
+            object.__delattr__(self, name)
 
     # ------------------------------------------------------------- parameters
     def create_parameter(

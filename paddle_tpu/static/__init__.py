@@ -1,0 +1,67 @@
+"""paddle.static surface (reference: python/paddle/static/).
+
+TPU-native stance (SURVEY.md §3.4): "static mode" is explicit jit capture —
+there is no global Program being mutated under the user. ``enable_static()``
+flips a flag consumed by dual-mode call sites; the real compiled path is
+``paddle_tpu.jit.to_static`` / ``jax.jit``. The Executor here runs captured
+programs (callables) rather than interpreting an op list — InterpreterCore's
+job (paddle/fluid/framework/new_executor/interpretercore.cc) belongs to XLA.
+"""
+from __future__ import annotations
+
+from ..jit import InputSpec  # noqa: F401
+
+_static_mode = False
+
+
+def _enable():
+    global _static_mode
+    _static_mode = True
+
+
+def _disable():
+    global _static_mode
+    _static_mode = False
+
+
+def _enabled():
+    return _static_mode
+
+
+class Program:
+    """Placeholder program object for API parity; holds a captured callable."""
+
+    def __init__(self, fn=None):
+        self._fn = fn
+
+    def clone(self, for_test=False):
+        return Program(self._fn)
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+class Executor:
+    """Runs captured callables (reference: python/paddle/base/executor.py —
+    but execution is jax.jit, so 'run' is a function call)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if program is None or program._fn is None:
+            return []
+        import jax
+
+        out = program._fn(**(feed or {}))
+        out = out if isinstance(out, (list, tuple)) else [out]
+        return [jax.device_get(getattr(o, "_data", o)) for o in out]
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)

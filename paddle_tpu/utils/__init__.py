@@ -1,0 +1,35 @@
+"""paddle.utils parity (reference: python/paddle/utils/)."""
+from . import unique_name  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the framework can compute."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.device_count()
+    x = jnp.ones((128, 128))
+    val = float(jax.device_get(jnp.sum(x @ x)))
+    assert val == 128.0 * 128 * 128
+    print(f"paddle_tpu is installed successfully! {n} device(s): "
+          f"{[d.device_kind for d in jax.devices()]}")
+
+
+def deprecated(update_to="", since="", reason=""):
+    import functools
+    import warnings
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            warnings.warn(
+                f"{fn.__name__} is deprecated since {since}: {reason} "
+                f"{'Use ' + update_to if update_to else ''}",
+                DeprecationWarning, stacklevel=2,
+            )
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

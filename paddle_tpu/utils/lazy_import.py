@@ -1,0 +1,12 @@
+"""try_import (reference: python/paddle/utils/lazy_import.py)."""
+import importlib
+
+
+def try_import(module_name: str, err_msg: str = None):
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"{module_name} is required but not installed "
+            "(no-network environment: dependency must be baked in)"
+        )

@@ -1,0 +1,89 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+No network in this environment: datasets read local files only
+(DatasetFolder) or generate synthetic data (FakeData for harnesses).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """ImageNet-style root/class_x/img.ext layout (reference:
+    python/paddle/vision/datasets/folder.py)."""
+
+    def __init__(self, root, loader: Optional[Callable] = None,
+                 extensions=IMG_EXTENSIONS, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._default_loader
+        classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        )
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for f in sorted(files):
+                    path = os.path.join(dirpath, f)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else f.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _default_loader(path):
+        if path.endswith(".npy"):
+            return np.load(path)
+        try:
+            from PIL import Image
+
+            with Image.open(path) as img:
+                return np.asarray(img.convert("RGB"))
+        except ImportError as e:
+            raise RuntimeError("PIL unavailable; use .npy files or a custom loader") from e
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+ImageFolder = DatasetFolder
+
+
+class FakeData(Dataset):
+    """Synthetic image dataset for harnesses/benchmarks (deterministic)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=1000,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, index):
+        rng = np.random.default_rng(self.seed + index)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size

@@ -1,0 +1,259 @@
+"""Image transforms (reference: python/paddle/vision/transforms/).
+
+Host-side numpy/PIL ops; CHW float32 output feeds DataLoader collate.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "RandomResizedCrop", "BrightnessTransform", "to_tensor", "normalize",
+    "resize", "hflip", "vflip", "center_crop", "crop", "pad",
+]
+
+
+def _to_numpy(img):
+    if isinstance(img, np.ndarray):
+        return img
+    # PIL image
+    return np.asarray(img)
+
+
+def _size_pair(size):
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def to_tensor(img, data_format="CHW"):
+    a = _to_numpy(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if a.dtype == np.uint8:
+        a = a.astype(np.float32) / 255.0
+    else:
+        a = a.astype(np.float32)
+    if data_format == "CHW":
+        a = np.transpose(a, (2, 0, 1))
+    return a
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    a = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        return (a - mean.reshape(-1, 1, 1)) / std.reshape(-1, 1, 1)
+    return (a - mean) / std
+
+
+def resize(img, size, interpolation="bilinear"):
+    a = _to_numpy(img)
+    h, w = a.shape[:2]
+    if isinstance(size, numbers.Number):
+        # shorter side -> size, keep aspect
+        if h < w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = _size_pair(size)
+    if (oh, ow) == (h, w):
+        return a
+    # vectorized bilinear on numpy (no PIL dependency at runtime)
+    ys = np.linspace(0, h - 1, oh, dtype=np.float32)
+    xs = np.linspace(0, w - 1, ow, dtype=np.float32)
+    y0 = np.floor(ys).astype(np.int32)
+    x0 = np.floor(xs).astype(np.int32)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    if a.ndim == 2:
+        a = a[:, :, None]
+    a = a.astype(np.float32)
+    top = a[y0][:, x0] * (1 - wx[..., None]) + a[y0][:, x1] * wx[..., None]
+    bot = a[y1][:, x0] * (1 - wx[..., None]) + a[y1][:, x1] * wx[..., None]
+    out = top * (1 - wy[..., None]) + bot * wy[..., None]
+    return out
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1]
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1]
+
+
+def crop(img, top, left, height, width):
+    return _to_numpy(img)[top : top + height, left : left + width]
+
+
+def center_crop(img, output_size):
+    a = _to_numpy(img)
+    th, tw = _size_pair(output_size)
+    h, w = a.shape[:2]
+    i = max(0, (h - th) // 2)
+    j = max(0, (w - tw) // 2)
+    return crop(a, i, j, th, tw)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    a = _to_numpy(img)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (a.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(a, pads, constant_values=fill)
+    return np.pad(a, pads, mode=padding_mode)
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = mean if not isinstance(mean, numbers.Number) else [mean] * 3
+        self.std = std if not isinstance(std, numbers.Number) else [std] * 3
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0):
+        self.size = _size_pair(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+
+    def _apply_image(self, img):
+        a = _to_numpy(img)
+        if self.padding is not None:
+            a = pad(a, self.padding, self.fill)
+        th, tw = self.size
+        h, w = a.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            a = pad(a, (max(0, tw - w), max(0, th - h)), self.fill)
+            h, w = a.shape[:2]
+        i = random.randint(0, h - th)
+        j = random.randint(0, w - tw)
+        return crop(a, i, j, th, tw)
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        self.size = _size_pair(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        a = _to_numpy(img)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = area * random.uniform(*self.scale)
+            aspect = np.exp(random.uniform(np.log(self.ratio[0]), np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target_area * aspect)))
+            ch = int(round(np.sqrt(target_area / aspect)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = random.randint(0, h - ch)
+                j = random.randint(0, w - cw)
+                return resize(crop(a, i, j, ch, cw), self.size, self.interpolation)
+        return resize(center_crop(a, min(h, w)), self.size, self.interpolation)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return hflip(img) if random.random() < self.prob else _to_numpy(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if random.random() < self.prob else _to_numpy(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.transpose(_to_numpy(img), self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value):
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        a = _to_numpy(img).astype(np.float32)
+        factor = 1 + random.uniform(-self.value, self.value)
+        return np.clip(a * factor, 0, 255 if a.max() > 1 else 1.0)

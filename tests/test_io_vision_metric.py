@@ -1,0 +1,197 @@
+"""io / vision / metric / profiler surface tests (reference patterns:
+test_multiprocess_dataloader_*.py, vision model tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler, DataLoader, Dataset, DistributedBatchSampler, TensorDataset,
+    random_split,
+)
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class TestDataLoader:
+    def test_basic_iteration(self):
+        dl = DataLoader(SquaresDataset(10), batch_size=4)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4]
+        np.testing.assert_allclose(y.numpy(), [0, 1, 4, 9])
+
+    def test_drop_last_and_shuffle(self):
+        dl = DataLoader(SquaresDataset(10), batch_size=4, drop_last=True, shuffle=True)
+        batches = list(dl)
+        assert len(batches) == 2
+        seen = np.concatenate([b[0].numpy() for b in batches])
+        assert len(set(seen.tolist())) == 8
+
+    def test_workers_preserve_order(self):
+        dl0 = DataLoader(SquaresDataset(31), batch_size=4, num_workers=0)
+        dl2 = DataLoader(SquaresDataset(31), batch_size=4, num_workers=2)
+        for (x0, y0), (x2, y2) in zip(dl0, dl2):
+            np.testing.assert_allclose(x0.numpy(), x2.numpy())
+
+    def test_tensor_dataset_and_split(self):
+        xs = np.arange(20, dtype=np.float32).reshape(20, 1)
+        ds = TensorDataset([xs, xs * 2])
+        a, b = random_split(ds, [15, 5])
+        assert len(a) == 15 and len(b) == 5
+        x, y = ds[3]
+        np.testing.assert_allclose(y, x * 2)
+
+    def test_distributed_batch_sampler_shards(self):
+        ds = SquaresDataset(20)
+        s0 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(i0) == len(i1) == 10
+        assert set(i0).isdisjoint(set(i1))
+
+    def test_iterable_dataset(self):
+        from paddle_tpu.io import IterableDataset
+
+        class Stream(IterableDataset):
+            def __iter__(self):
+                for i in range(7):
+                    yield np.float32(i)
+
+        dl = DataLoader(Stream(), batch_size=3)
+        batches = list(dl)
+        assert [b.shape[0] for b in batches] == [3, 3, 1]
+
+
+class TestVision:
+    def test_resnet18_forward_backward(self, rng):
+        net = paddle.vision.models.resnet18(num_classes=10)
+        x = paddle.to_tensor(rng.standard_normal((2, 3, 32, 32)).astype(np.float32))
+        out = net(x)
+        assert out.shape == [2, 10]
+        loss = out.sum()
+        loss.backward()
+        assert net.conv1.weight.grad is not None
+
+    def test_lenet(self, rng):
+        net = paddle.vision.models.LeNet()
+        x = paddle.to_tensor(rng.standard_normal((2, 1, 28, 28)).astype(np.float32))
+        assert net(x).shape == [2, 10]
+
+    def test_mobilenet_builds(self, rng):
+        net = paddle.vision.models.mobilenet_v2(num_classes=4)
+        x = paddle.to_tensor(rng.standard_normal((1, 3, 32, 32)).astype(np.float32))
+        assert net(x).shape == [1, 4]
+
+    def test_transforms(self, rng):
+        from paddle_tpu.vision import transforms as T
+
+        img = (rng.random((40, 60, 3)) * 255).astype(np.uint8)
+        pipeline = T.Compose([
+            T.Resize(32), T.CenterCrop(32), T.ToTensor(),
+            T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+        ])
+        out = pipeline(img)
+        assert out.shape == (3, 32, 32)
+        assert out.dtype == np.float32
+
+    def test_fake_data_with_loader(self):
+        from paddle_tpu.vision.datasets import FakeData
+
+        ds = FakeData(size=8, image_shape=(3, 8, 8), num_classes=5)
+        dl = DataLoader(ds, batch_size=4)
+        x, y = next(iter(dl))
+        assert x.shape == [4, 3, 8, 8]
+        assert y.shape == [4]
+
+
+class TestMetric:
+    def test_accuracy_topk(self):
+        from paddle_tpu.metric import Accuracy
+
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32)
+        label = np.array([1, 2])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == 0.5  # first correct, second wrong
+        assert top2 == 0.5
+
+    def test_precision_recall(self):
+        from paddle_tpu.metric import Precision, Recall
+
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect(self):
+        from paddle_tpu.metric import Auc
+
+        m = Auc()
+        m.update(np.array([0.9, 0.8, 0.1, 0.2]), np.array([1, 1, 0, 0]))
+        assert m.accumulate() > 0.99
+
+    def test_functional_accuracy(self):
+        acc = paddle.metric.accuracy(
+            paddle.to_tensor(np.array([[0.1, 0.9], [0.9, 0.1]], np.float32)),
+            paddle.to_tensor(np.array([1, 1])),
+        )
+        assert abs(float(acc) - 0.5) < 1e-6
+
+
+class TestProfilerFacade:
+    def test_scheduler_states(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+
+        sched = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sched(i) for i in range(4)]
+        assert states[0] == ProfilerState.CLOSED
+        assert states[1] == ProfilerState.READY
+        assert states[2] == ProfilerState.RECORD
+        assert states[3] == ProfilerState.RECORD_AND_RETURN
+
+    def test_timer_only_profiler(self):
+        import paddle_tpu.profiler as profiler
+
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        for _ in range(3):
+            p.step()
+        p.stop()
+        assert "steps: 3" in p.summary()
+
+    def test_mfu_readout(self):
+        from paddle_tpu.profiler import mfu
+
+        v = mfu(n_params=1e9, tokens_per_sec_per_chip=1000, peak_flops_per_chip=1e13)
+        assert abs(v - 6e12 / 1e13) < 1e-9
+
+
+class TestDeviceNS:
+    def test_device_queries(self):
+        assert isinstance(paddle.device.get_all_device_type(), list)
+        paddle.device.synchronize()
+        s = paddle.device.current_stream()
+        s.synchronize()
+
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+
+        with unique_name.guard():
+            a = unique_name.generate("fc")
+            b = unique_name.generate("fc")
+        assert a != b

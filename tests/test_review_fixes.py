@@ -103,3 +103,68 @@ def test_layer_param_reassignment_consistent():
     np.testing.assert_allclose(lin.weight.numpy(), np.zeros((2, 2)))
     out = lin(t(np.ones((1, 2))))
     np.testing.assert_allclose(out.numpy(), lin.bias.numpy()[None, :], rtol=1e-6)
+
+
+# ---- round-2 review fixes ----
+
+
+def test_gradscaler_manual_pattern_rearms_each_iteration():
+    w = paddle.framework.Parameter(np.ones(2, dtype=np.float32))
+    opt = optimizer.SGD(learning_rate=1.0, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=2.0**10)
+    for i in range(3):
+        loss = (w * 2.0).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        np.testing.assert_allclose(w.grad.numpy(), [2.0, 2.0])  # unscaled every iter
+        opt.step()
+        scaler.update()
+        opt.clear_grad()
+
+
+def test_layer_delattr_removes_attribute():
+    lin = nn.Linear(2, 2)
+    del lin.bias
+    import pytest as _pytest
+
+    with _pytest.raises(AttributeError):
+        _ = lin.bias
+    assert "bias" not in dict(lin.named_parameters())
+
+
+def test_to_static_kwargs_forwarded():
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(x, scale=1.0):
+        return x * scale
+
+    out = f(t([1.0, 2.0]), scale=3.0)
+    np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+
+
+def test_to_static_layer_updates_bn_buffers(rng):
+    net = nn.Sequential(nn.Conv2D(2, 2, 1), nn.BatchNorm2D(2))
+    net.train()
+    from paddle_tpu.jit import to_static
+
+    st = to_static(net)
+    x = t(rng.standard_normal((4, 2, 5, 5)) * 3 + 1)
+    st(x)
+    bn = net[1]
+    assert not np.allclose(bn._mean.numpy(), np.zeros(2))
+
+
+def test_jit_save_dynamic_batch(tmp_path, rng):
+    from paddle_tpu.jit import InputSpec, save, load
+
+    net = nn.Sequential(nn.Linear(4, 3))
+    net.eval()
+    path = str(tmp_path / "dyn")
+    save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = load(path)
+    for bs in (1, 2, 5):
+        x = rng.standard_normal((bs, 4)).astype(np.float32)
+        np.testing.assert_allclose(
+            loaded(t(x)).numpy(), net(t(x)).numpy(), rtol=1e-5, atol=1e-6
+        )

@@ -1,0 +1,261 @@
+"""Eager + in-jit collectives — ProcessGroupXLA (SURVEY.md A14/§5.8).
+
+Two regimes, matching the plan in SURVEY.md:
+
+* **inside-jit** (the perf path): ``fcollectives`` — thin wrappers over
+  ``lax.psum/all_gather/ppermute/all_to_all`` keyed on a mesh axis name.
+  These are what TP/DP/PP layers use under ``shard_map``/pjit; XLA schedules
+  them onto ICI with async start/done pairs (replacing the reference's
+  per-group NCCL comm streams + events, process_group_nccl.cc).
+* **eager** (control plane / API compat): host-mediated collectives over the
+  jax.distributed coordination service via ``multihost_utils`` when running
+  multi-process; identity when world_size == 1. Used for init broadcast,
+  found_inf reduction, metrics — never in the step hot loop.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .topology import Group
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "reduce", "scatter", "all_to_all", "reduce_scatter", "barrier",
+    "send", "recv", "fcollectives",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _world():
+    from .parallel import _env
+
+    return _env
+
+
+def _group_or_world(group: Optional[Group]) -> Group:
+    if group is not None:
+        return group
+    env = _world()
+    return Group(list(range(env.world_size)), axis_name=None, rank=env.rank)
+
+
+def _is_member(group: Group) -> bool:
+    return _world().rank in group.ranks
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def _gather_stack(arr, group: Group):
+    """All ranks' arrays stacked on axis 0 (multi-process path)."""
+    from jax.experimental import multihost_utils
+
+    # coordination-service allgather over ALL processes, then select group
+    gathered = multihost_utils.process_allgather(np.asarray(jax.device_get(arr)))
+    return gathered[np.asarray(group.ranks)]
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    """In-place eager allreduce (reference: paddle.distributed.all_reduce,
+    python/paddle/distributed/communication/all_reduce.py)."""
+    group = _group_or_world(group)
+    if group.nranks <= 1 or _world().world_size <= 1 or not _is_member(group):
+        return tensor
+    stacked = _gather_stack(_unwrap(tensor), group)
+    red = {
+        ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
+        ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
+    }[op](stacked, axis=0)
+    out = jnp.asarray(red)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group: Optional[Group] = None, sync_op=True):
+    group = _group_or_world(group)
+    arr = _unwrap(tensor)
+    if group.nranks <= 1 or _world().world_size <= 1:
+        parts = [arr]
+    else:
+        parts = list(_gather_stack(arr, group))
+    for p in parts:
+        tensor_list.append(Tensor._wrap(jnp.asarray(p)))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group: Optional[Group] = None):
+    import pickle
+
+    group = _group_or_world(group)
+    if group.nranks <= 1 or _world().world_size <= 1:
+        object_list.append(obj)
+        return object_list
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    # pad to common size (coordination allgather needs same shape)
+    size = np.asarray([payload.size])
+    sizes = multihost_utils.process_allgather(size)[:, 0]
+    buf = np.zeros(int(sizes.max()), np.uint8)
+    buf[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(buf)
+    for r in group.ranks:
+        object_list.append(pickle.loads(gathered[r][: sizes[r]].tobytes()))
+    return object_list
+
+
+def broadcast(tensor, src: int, group: Optional[Group] = None, sync_op=True):
+    group = _group_or_world(group)
+    if group.nranks <= 1 or _world().world_size <= 1 or not _is_member(group):
+        return tensor
+    stacked = _gather_stack(_unwrap(tensor), group)
+    out = jnp.asarray(stacked[group.get_group_rank(src) if src in group.ranks else src])
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
+
+
+def reduce(tensor, dst: int, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
+    out = all_reduce(tensor, op=op, group=group)
+    # non-dst ranks keep the reduced value too (documented relaxation; the
+    # reference leaves their buffers undefined)
+    return out
+
+
+def scatter(tensor, tensor_list=None, src: int = 0, group: Optional[Group] = None,
+            sync_op=True):
+    group = _group_or_world(group)
+    env = _world()
+    if group.nranks <= 1 or env.world_size <= 1:
+        if tensor_list:
+            src_val = tensor_list[0]
+            tensor._data = _unwrap(src_val)
+        return tensor
+    # src rank contributes the list; others receive their slice
+    obj = [np.asarray(jax.device_get(_unwrap(t))) for t in (tensor_list or [])]
+    gathered: list = []
+    all_gather_object(gathered, obj, group=Group(group.ranks, rank=group.rank))
+    src_objs = gathered[group.get_group_rank(src)]
+    tensor._data = jnp.asarray(src_objs[group.rank])
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
+               sync_op=True):
+    group = _group_or_world(group)
+    env = _world()
+    if group.nranks <= 1 or env.world_size <= 1:
+        out_tensor_list.extend(in_tensor_list)
+        return out_tensor_list
+    objs: list = []
+    all_gather_object(
+        objs, [np.asarray(jax.device_get(_unwrap(t))) for t in in_tensor_list],
+        group=group,
+    )
+    me = group.rank
+    for r in range(group.nranks):
+        out_tensor_list.append(Tensor._wrap(jnp.asarray(objs[r][me])))
+    return out_tensor_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True):
+    group = _group_or_world(group)
+    env = _world()
+    if group.nranks <= 1 or env.world_size <= 1:
+        tensor._data = _unwrap(tensor_list[0])
+        return tensor
+    objs: list = []
+    all_gather_object(
+        objs, [np.asarray(jax.device_get(_unwrap(t))) for t in tensor_list],
+        group=group,
+    )
+    me = group.rank
+    acc = None
+    for r in range(group.nranks):
+        part = objs[r][me]
+        acc = part if acc is None else acc + part
+    tensor._data = jnp.asarray(acc)
+    return tensor
+
+
+def barrier(group: Optional[Group] = None):
+    if _world().world_size <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
+
+
+def send(tensor, dst: int, group: Optional[Group] = None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send/recv is not part of the TPU execution model; pipeline "
+        "communication is compiled (lax.ppermute over the 'pp' mesh axis — "
+        "see paddle_tpu.distributed.fleet.meta_parallel pipeline engine)"
+    )
+
+
+def recv(tensor, src: int, group: Optional[Group] = None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send/recv is not part of the TPU execution model; pipeline "
+        "communication is compiled (lax.ppermute over the 'pp' mesh axis)"
+    )
+
+
+class fcollectives:
+    """In-jit functional collectives over mesh axis names — usable only
+    inside shard_map/pjit tracing (reference counterparts: the static-graph
+    collective ops, paddle/fluid/operators/collective/)."""
+
+    @staticmethod
+    def all_reduce(x, axis_name: str, op=ReduceOp.SUM):
+        if op == ReduceOp.SUM:
+            return jax.lax.psum(x, axis_name)
+        if op == ReduceOp.MAX:
+            return jax.lax.pmax(x, axis_name)
+        if op == ReduceOp.MIN:
+            return jax.lax.pmin(x, axis_name)
+        if op == ReduceOp.AVG:
+            return jax.lax.pmean(x, axis_name)
+        raise ValueError(op)
+
+    @staticmethod
+    def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+        return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+    @staticmethod
+    def reduce_scatter(x, axis_name: str, axis: int = 0):
+        return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+    @staticmethod
+    def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int):
+        return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                                  concat_axis=concat_axis, tiled=True)
+
+    @staticmethod
+    def ppermute(x, axis_name: str, perm):
+        return jax.lax.ppermute(x, axis_name, perm)
+
+    @staticmethod
+    def axis_index(axis_name: str):
+        return jax.lax.axis_index(axis_name)
+
+    @staticmethod
+    def psum(x, axis_name: str):
+        return jax.lax.psum(x, axis_name)

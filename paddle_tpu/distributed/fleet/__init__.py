@@ -1,0 +1,23 @@
+"""Fleet facade (reference: python/paddle/distributed/fleet/fleet.py).
+
+Same three calls users know — ``fleet.init(is_collective=True, strategy)``,
+``fleet.distributed_model(model)``, ``fleet.distributed_optimizer(opt)`` —
+but the strategy resolves to a Mesh + sharding-spec policies instead of a
+wrapper-class stack (SURVEY.md C4: "strategy dataclass → Mesh axes +
+wrapper selection")."""
+from __future__ import annotations
+
+from .base.distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    _FleetState,
+    distributed_model,
+    distributed_optimizer,
+    fleet_state,
+    get_hybrid_communicate_group,
+    init,
+    is_first_worker,
+    worker_index,
+    worker_num,
+)
+from . import meta_parallel  # noqa: F401
+from .utils import log_util  # noqa: F401

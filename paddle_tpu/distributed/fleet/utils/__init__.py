@@ -1,0 +1,2 @@
+from . import log_util  # noqa: F401
+from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401

@@ -1,0 +1,51 @@
+"""Hybrid comm utils (reference: fleet/utils/hybrid_parallel_util.py)."""
+from __future__ import annotations
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Manual dp grad sync (reference fused_allreduce_gradients — used when
+    DataParallel auto-sync is off). Eager path; the compiled step does this
+    via psum."""
+    from ...collective import ReduceOp, all_reduce
+    from ...parallel import get_world_size
+
+    if get_world_size() <= 1:
+        return
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    for p in parameter_list:
+        if getattr(p, "grad", None) is not None:
+            all_reduce(p.grad, op=ReduceOp.AVG, group=group)
+
+
+def broadcast_dp_parameters(model, hcg):
+    from ...collective import broadcast
+    from ...parallel import get_world_size
+
+    if get_world_size() <= 1:
+        return
+    group = hcg.get_data_parallel_group()
+    for p in model.parameters():
+        broadcast(p, src=group.ranks[0], group=group)
+
+
+def broadcast_mp_parameters(model, hcg):
+    from ...collective import broadcast
+    from ...parallel import get_world_size
+
+    if get_world_size() <= 1:
+        return
+    group = hcg.get_model_parallel_group()
+    for p in model.parameters():
+        if not getattr(p, "is_distributed", False):
+            broadcast(p, src=group.ranks[0], group=group)
+
+
+def broadcast_sharding_parameters(model, hcg):
+    from ...collective import broadcast
+    from ...parallel import get_world_size
+
+    if get_world_size() <= 1:
+        return
+    group = hcg.get_sharding_parallel_group()
+    for p in model.parameters():
+        broadcast(p, src=group.ranks[0], group=group)

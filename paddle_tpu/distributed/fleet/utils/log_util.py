@@ -1,0 +1,34 @@
+"""Rank-0 logger (reference: python/paddle/distributed/fleet/utils/log_util.py)."""
+from __future__ import annotations
+
+import logging
+import sys
+
+
+class _Rank0Filter(logging.Filter):
+    def filter(self, record):
+        from ...parallel import get_rank
+
+        return get_rank() == 0
+
+
+logger = logging.getLogger("paddle_tpu.fleet")
+if not logger.handlers:
+    _h = logging.StreamHandler(sys.stderr)
+    _h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname)s [rank-0] %(message)s"))
+    _h.addFilter(_Rank0Filter())
+    logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
+
+def set_log_level(level):
+    logger.setLevel(level)
+
+
+def layer_to_str(base, *args, **kwargs):
+    name = base + "("
+    name += ", ".join(str(a) for a in args)
+    if kwargs:
+        name += ", " + ", ".join(f"{k}={v}" for k, v in kwargs.items())
+    return name + ")"

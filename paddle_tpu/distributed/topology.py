@@ -1,0 +1,224 @@
+"""Hybrid-parallel topology (reference: python/paddle/distributed/fleet/base/
+topology.py — CommunicateTopology + HybridCommunicateGroup).
+
+TPU-native: the 5-D logical grid ["dp","pp","sharding","sep","mp"] IS a
+``jax.sharding.Mesh``. The reference builds one NCCL subgroup per axis slice
+by rank arithmetic; here the same arithmetic orders the device list for the
+mesh, and "groups" are mesh axis names consumed by collectives inside jit.
+Axis placement follows SURVEY.md §5.8: mp (highest-frequency collectives)
+innermost/fastest-varying so it lands on adjacent ICI neighbours, dp
+outermost so it can ride DCN.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+HYBRID_AXES = ("dp", "pp", "sharding", "sep", "mp")
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names: Sequence[str] = HYBRID_AXES,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*(range(d) for d in dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return [r for r, c in enumerate(self.coordinate) if c[axis] == index]
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All groups along ``axis_name``: ranks that differ only in that
+        coordinate (reference: CommunicateTopology.get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for other in itertools.product(*(range(self._dims[i]) for i in other_axes)):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        coord = list(self.get_coord(global_rank))
+        for name, v in kwargs.items():
+            coord[self._parallel_names.index(name)] = v
+        return self._coord2rank[tuple(coord)]
+
+
+class HybridCommunicateGroup:
+    """Query API over the topology (reference: HybridCommunicateGroup in
+    fleet/base/topology.py). Groups are (ranks, axis_name) pairs; the axis
+    name is what in-jit collectives use."""
+
+    def __init__(self, topology: CommunicateTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.nranks = topology.world_size()
+        self._dp_degree = topology.get_dim("dp")
+        self._pp_degree = topology.get_dim("pp")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._sep_degree = topology.get_dim("sep") if "sep" in topology.get_hybrid_group_names() else 1
+        self._mp_degree = topology.get_dim("mp")
+        coord = topology.get_coord(global_rank)
+        names = topology.get_hybrid_group_names()
+        self._coord = dict(zip(names, coord))
+
+    # ---- degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ---- ranks within groups
+    def get_data_parallel_rank(self):
+        return self._coord["dp"]
+
+    def get_model_parallel_rank(self):
+        return self._coord["mp"]
+
+    def get_stage_id(self):
+        return self._coord["pp"]
+
+    get_pipe_parallel_rank = get_stage_id
+
+    def get_sharding_parallel_rank(self):
+        return self._coord["sharding"]
+
+    def get_sep_parallel_rank(self):
+        return self._coord["sep"]
+
+    # ---- group membership (rank lists, axis names)
+    def _group(self, axis: str):
+        index_coord = {k: v for k, v in self._coord.items() if k != axis}
+        ranks = [
+            r for r in range(self.nranks)
+            if all(
+                self._topo.get_coord(r)[self._topo.get_hybrid_group_names().index(k)] == v
+                for k, v in index_coord.items()
+            )
+        ]
+        return ranks
+
+    def get_data_parallel_group(self):
+        return Group(self._group("dp"), axis_name="dp", rank=self._coord["dp"])
+
+    def get_model_parallel_group(self):
+        return Group(self._group("mp"), axis_name="mp", rank=self._coord["mp"])
+
+    def get_pipe_parallel_group(self):
+        return Group(self._group("pp"), axis_name="pp", rank=self._coord["pp"])
+
+    def get_sharding_parallel_group(self):
+        return Group(self._group("sharding"), axis_name="sharding",
+                     rank=self._coord["sharding"])
+
+    def get_sep_parallel_group(self):
+        return Group(self._group("sep"), axis_name="sep", rank=self._coord["sep"])
+
+    def get_check_parallel_group(self, sharding=False):
+        return Group(list(range(self.nranks)), axis_name=None, rank=self.global_rank)
+
+    # ---- pipeline neighbours
+    def is_first_stage(self):
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self):
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_p2p_groups(self):
+        stage = self.get_stage_id()
+        prev_rank = self._topo.get_rank_from_stage(
+            self.global_rank, pp=(stage - 1) % self._pp_degree
+        )
+        next_rank = self._topo.get_rank_from_stage(
+            self.global_rank, pp=(stage + 1) % self._pp_degree
+        )
+        return prev_rank, next_rank
+
+    def topology(self):
+        return self._topo
+
+
+class Group:
+    """Communication group handle (reference: paddle.distributed Group).
+
+    ``axis_name`` is set for mesh-axis groups — in-jit collectives use it
+    with lax.p* ops; ``ranks`` is the explicit member list for control-plane
+    use."""
+
+    _next_id = 0
+
+    def __init__(self, ranks: List[int], axis_name: Optional[str] = None,
+                 rank: int = 0, backend: str = "xla"):
+        self.ranks = list(ranks)
+        self.axis_name = axis_name
+        self.rank = rank
+        self.nranks = len(ranks)
+        self.backend = backend
+        Group._next_id += 1
+        self.id = Group._next_id
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, global_rank: int) -> int:
+        return self.ranks.index(global_rank)
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, ranks={self.ranks})"
+
+
+def build_mesh(dp=1, pp=1, sharding=1, sep=1, mp=1, devices=None):
+    """Construct the hybrid Mesh. Device order mirrors the reference's rank
+    arithmetic (mp fastest-varying — fleet/base/topology.py builds mp groups
+    from consecutive ranks), which on a TPU slice keeps mp neighbours
+    ICI-adjacent."""
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(jax.devices()) if devices is None else list(devices)
+    need = dp * pp * sharding * sep * mp
+    if need > len(devices):
+        raise ValueError(
+            f"mesh needs {need} devices (dp{dp}*pp{pp}*sharding{sharding}"
+            f"*sep{sep}*mp{mp}) but only {len(devices)} available"
+        )
+    arr = np.array(devices[:need]).reshape(dp, pp, sharding, sep, mp)
+    return Mesh(arr, HYBRID_AXES)

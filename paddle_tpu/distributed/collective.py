@@ -72,9 +72,13 @@ def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=T
     """In-place eager allreduce (reference: paddle.distributed.all_reduce,
     python/paddle/distributed/communication/all_reduce.py)."""
     group = _group_or_world(group)
-    if group.nranks <= 1 or _world().world_size <= 1 or not _is_member(group):
+    if group.nranks <= 1 or _world().world_size <= 1:
         return tensor
+    # process_allgather is a collective over ALL processes — non-members must
+    # still participate (then discard) or member ranks deadlock waiting
     stacked = _gather_stack(_unwrap(tensor), group)
+    if not _is_member(group):
+        return tensor
     red = {
         ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
         ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
@@ -121,10 +125,16 @@ def all_gather_object(object_list, obj, group: Optional[Group] = None):
 
 def broadcast(tensor, src: int, group: Optional[Group] = None, sync_op=True):
     group = _group_or_world(group)
-    if group.nranks <= 1 or _world().world_size <= 1 or not _is_member(group):
+    if group.nranks <= 1 or _world().world_size <= 1:
         return tensor
-    stacked = _gather_stack(_unwrap(tensor), group)
-    out = jnp.asarray(stacked[group.get_group_rank(src) if src in group.ranks else src])
+    if src not in group.ranks:
+        raise ValueError(
+            f"broadcast src rank {src} is not a member of group {group.ranks}"
+        )
+    stacked = _gather_stack(_unwrap(tensor), group)  # all-process collective
+    if not _is_member(group):
+        return tensor
+    out = jnp.asarray(stacked[group.get_group_rank(src)])
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -187,11 +197,12 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
         group=group,
     )
     me = group.rank
-    acc = None
-    for r in range(group.nranks):
-        part = objs[r][me]
-        acc = part if acc is None else acc + part
-    tensor._data = jnp.asarray(acc)
+    parts = np.stack([objs[r][me] for r in range(group.nranks)])
+    red = {
+        ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
+        ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
+    }[op](parts, axis=0)
+    tensor._data = jnp.asarray(red)
     return tensor
 
 

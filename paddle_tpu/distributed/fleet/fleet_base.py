@@ -43,19 +43,25 @@ def init(role_maker=None, is_collective=True, strategy: Optional[DistributedStra
     dp = hc["dp_degree"]
     used = mp * pp * sharding * sep
     if dp * used != n_devices:
-        if n_devices % used == 0:
-            dp = n_devices // used  # auto-infer dp (reference does the same)
+        # auto-infer ONLY when dp was left at its default; an explicit
+        # mismatched dp_degree is a config error (reference errors too)
+        if dp == 1 and n_devices % used == 0:
+            dp = n_devices // used
         else:
             raise ValueError(
-                f"hybrid degrees {hc} do not divide device count {n_devices}"
+                f"hybrid degrees {hc} do not match device count {n_devices} "
+                f"(dp*mp*pp*sharding*sep = {dp * used})"
             )
     strategy.hybrid_configs = {"dp_degree": dp}
 
     init_parallel_env()
     topo = CommunicateTopology(HYBRID_AXES, (dp, pp, sharding, sep, mp))
-    # per-process global rank for topology queries: with one process per
-    # host owning many chips, rank queries use the process's first device
-    hcg = HybridCommunicateGroup(topo, global_rank=_env.rank)
+    # Topology coordinates are DEVICE (chip) indices. With one process per
+    # host owning local_device_count chips, this process's anchor coordinate
+    # is its first local device's position in the global device list — not
+    # the process index itself.
+    local = max(1, n_devices // max(1, _env.world_size))
+    hcg = HybridCommunicateGroup(topo, global_rank=_env.rank * local)
     mesh = build_mesh(dp=dp, pp=pp, sharding=sharding, sep=sep, mp=mp)
 
     fleet_state.initialized = True
@@ -95,11 +101,22 @@ def distributed_model(model):
     """
     if not fleet_state.initialized:
         raise RuntimeError("call fleet.init() first")
-    from .meta_parallel.pp_layers import PipelineLayer
-    from .meta_parallel.pipeline_engine import PipelineParallel
+    try:
+        from .meta_parallel.pp_layers import PipelineLayer
+        from .meta_parallel.pipeline_engine import PipelineParallel
+    except ImportError:
+        PipelineLayer = PipelineParallel = None
 
-    if isinstance(model, PipelineLayer):
+    if PipelineLayer is not None and isinstance(model, PipelineLayer):
         return PipelineParallel(model, fleet_state.hcg, fleet_state.strategy)
+
+    from .meta_parallel.tensor_parallel import TensorParallel, apply_dist_specs
+
+    if fleet_state.topology.get_dim("mp") > 1:
+        return TensorParallel(model, fleet_state.hcg, fleet_state.strategy)
+    # pure dp / sharding: placement only (grads psum'd by GSPMD in the
+    # compiled step; eager path uses DataParallel.apply_collective_grads)
+    apply_dist_specs(model, fleet_state.mesh)
     return model
 
 

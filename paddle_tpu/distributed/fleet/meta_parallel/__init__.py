@@ -1,0 +1,27 @@
+"""Meta-parallel engines (reference: python/paddle/distributed/fleet/
+meta_parallel/)."""
+from .meta_parallel_base import MetaParallelBase
+from .mp_layers import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    parallel_cross_entropy_shardmap,
+)
+from .random import (
+    MODEL_PARALLEL_RNG,
+    RNGStatesTracker,
+    determinate_seed,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+)
+from .tensor_parallel import TensorParallel, apply_dist_specs, param_shardings
+
+__all__ = [
+    "MetaParallelBase",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "parallel_cross_entropy_shardmap",
+    "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",
+    "determinate_seed", "MODEL_PARALLEL_RNG",
+    "TensorParallel", "apply_dist_specs", "param_shardings",
+]

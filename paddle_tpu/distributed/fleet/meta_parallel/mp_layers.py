@@ -22,7 +22,6 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from .... import nn
-from ....framework.tensor import Tensor, apply_op
 from ....nn import functional as F
 
 __all__ = [

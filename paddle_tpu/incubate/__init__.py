@@ -1,0 +1,6 @@
+"""paddle.incubate parity surface (reference: python/paddle/incubate/) —
+experimental fused layers + distributed models (MoE lands with the EP
+milestone)."""
+from . import nn  # noqa: F401
+
+__all__ = ["nn"]

@@ -1,0 +1,16 @@
+"""paddle.incubate.nn parity (reference: python/paddle/incubate/nn/)."""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = [
+    "functional",
+    "FusedFeedForward",
+    "FusedMultiHeadAttention",
+    "FusedMultiTransformer",
+    "FusedTransformerEncoderLayer",
+]

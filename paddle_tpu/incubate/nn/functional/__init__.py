@@ -1,0 +1,138 @@
+"""incubate.nn.functional (reference: python/paddle/incubate/nn/functional/
+— fused_multi_transformer, fused_feedforward, fused_multi_head_attention,
+masked_multihead_attention)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ....framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "fused_feedforward",
+    "fused_multi_head_attention",
+    "masked_multihead_attention",
+]
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5, pre_layer_norm=False,
+                      training=True, mode="upscale_in_train", name=None):
+    """Functional twin of FusedFeedForward (reference:
+    incubate/nn/functional/fused_transformer.py fused_feedforward)."""
+    from ....nn import functional as F
+
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, [d], ln1_scale, ln1_bias, ln1_epsilon)
+    act = {"gelu": lambda a: F.gelu(a, approximate=True), "relu": F.relu}[activation]
+    h = act(x.matmul(linear1_weight) + (linear1_bias if linear1_bias is not None else 0))
+    h = F.dropout(h, p=dropout1_rate, training=training)
+    h = h.matmul(linear2_weight) + (linear2_bias if linear2_bias is not None else 0)
+    h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [d], ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                               pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                               ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None, attn_mask=None,
+                               dropout_rate=0.5, attn_dropout_rate=0.5,
+                               ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, name=None):
+    """Functional twin of FusedMultiHeadAttention. qkv_weight layout
+    [3, nh, hd, H] (trans_qkvw)."""
+    from ....nn import functional as F
+    from ..layer.fused_transformer import _qkv_pack
+
+    residual = x
+    d = x.shape[-1]
+    if pre_layer_norm:
+        x = F.layer_norm(x, [d], pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    b, s, _ = x.shape
+    qkv = _qkv_pack(x, qkv_weight, qkv_bias)
+    q, k, v = qkv.unbind(axis=2)
+    if attn_mask is not None:
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask,
+                                             dropout_p=attn_dropout_rate,
+                                             training=training)
+    else:
+        out, _ = F.flash_attention(q, k, v, dropout=attn_dropout_rate,
+                                   causal=False, training=training)
+    out = out.reshape([b, s, d]).matmul(linear_weight)
+    if linear_bias is not None:
+        out = out + linear_bias
+    out = F.dropout(out, p=dropout_rate, training=training)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [d], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def masked_multihead_attention(x, cache_kv=None, src_mask=None, cum_offsets=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               beam_cache_offset=None, qkv_out_scale=None,
+                               out_shift=None, out_smooth=None, seq_len=1,
+                               rotary_emb_dims=0, use_neox_rotary_style=False,
+                               compute_dtype="default", out_scale=-1,
+                               quant_round_type=1, quant_max_bound=127.0,
+                               quant_min_bound=-127.0):
+    """Single-token decode attention against a KV cache (reference:
+    paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu).
+
+    ``x`` is the current token's packed qkv [B, 3*H]; ``cache_kv`` is
+    [2, B, nh, S, hd]; ``sequence_lengths`` [B] gives each element's current
+    length (the new token is written at that index). Returns
+    (out [B, H], updated cache_kv) — functional cache update.
+    """
+    from ....ops.pallas.decode_attention import decode_attention
+
+    unsupported = {
+        "src_mask": src_mask, "cum_offsets": cum_offsets,
+        "rotary_tensor": rotary_tensor, "beam_cache_offset": beam_cache_offset,
+        "qkv_out_scale": qkv_out_scale, "out_shift": out_shift,
+        "out_smooth": out_smooth,
+    }
+    bad = [k for k, v in unsupported.items() if v is not None]
+    if rotary_emb_dims:
+        bad.append("rotary_emb_dims")
+    if out_scale != -1:
+        bad.append("out_scale")
+    if bad:
+        raise NotImplementedError(
+            f"masked_multihead_attention: unsupported arguments {bad} "
+            "(rotary/quant variants are not implemented — silently dropping "
+            "them would compute wrong attention)")
+
+    xv = _unwrap(x)
+    cv = _unwrap(cache_kv)
+    _, bsz, nh, smax, hd = cv.shape
+    qkv = xv.reshape(bsz, 3, nh, hd)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]  # [b,nh,hd]
+    if sequence_lengths is None:
+        raise ValueError("masked_multihead_attention requires sequence_lengths")
+    lens = _unwrap(sequence_lengths).astype(jnp.int32).reshape(-1)
+
+    # per-batch sliced write of the new token at position lens[b]
+    upd = jnp.stack([k, v]).astype(cv.dtype)  # [2,b,nh,hd]
+    cv = jax.vmap(
+        lambda c, u, l: jax.lax.dynamic_update_slice(c, u[:, :, None], (0, 0, l, 0)),
+        in_axes=(1, 1, 0), out_axes=1,
+    )(cv, upd, lens)
+    out = decode_attention(q, cv[0], cv[1], lens + 1)
+    out = out.reshape(bsz, nh * hd)
+    return Tensor._wrap(out), Tensor._wrap(cv)

@@ -1,0 +1,361 @@
+"""Fused transformer layers (reference: python/paddle/incubate/nn/layer/
+fused_transformer.py — FusedMultiHeadAttention, FusedFeedForward,
+FusedMultiTransformer, FusedTransformerEncoderLayer; backed by
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu and
+fused_attention_op.cu / fused_feedforward_op.cu).
+
+TPU-native translation (SURVEY.md A3.x plan): the per-layer dataflow is the
+same — pre-LN → packed QKV GEMM → attention → out-proj (+mp allreduce) →
+residual+LN → FFN1 → act → FFN2 (+mp allreduce) → residual — but the GEMMs
+stay XLA (MXU), attention routes to the Pallas flash kernel (context phase)
+or the Pallas decode kernel with KV cache (generation phase), and the
+`ring_id` mp-allreduce hook becomes a sharding spec: weights carry 'mp'
+PartitionSpecs so GSPMD inserts the collectives the CUDA kernel hand-rolls.
+
+Weight-layout parity for checkpoint import: qkv weight is stored
+[3, num_heads, head_dim, embed_dim] (trans_qkvw=True layout), qkv bias
+[3, num_heads, head_dim], caches [2, bsz, num_heads, max_seq, head_dim] —
+exactly the reference's shapes.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .... import nn
+from ....framework.tensor import Tensor, apply_op
+from ....nn import functional as F
+
+__all__ = [
+    "FusedMultiHeadAttention",
+    "FusedFeedForward",
+    "FusedMultiTransformer",
+    "FusedTransformerEncoderLayer",
+]
+
+
+def _act(name):
+    return {"gelu": lambda x: F.gelu(x, approximate=True), "relu": F.relu}[name]
+
+
+class FusedMultiHeadAttention(nn.Layer):
+    """Pre/post-LN + packed QKV + attention + out-proj + dropout/residual in
+    one composite (reference: fused_attention_op.cu). XLA fuses the
+    elementwise epilogues; attention is the Pallas flash kernel."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+
+        self.qkv_weight = self.create_parameter(
+            shape=[3, num_heads, self.head_dim, embed_dim], attr=qkv_weight_attr)
+        self.qkv_weight.is_distributed = True
+        self.qkv_weight.dist_spec = P(None, "mp", None, None)
+        self.qkv_bias = self.create_parameter(
+            shape=[3, num_heads, self.head_dim], attr=qkv_bias_attr, is_bias=True)
+        self.qkv_bias.is_distributed = True
+        self.qkv_bias.dist_spec = P(None, "mp", None)
+        self.linear_weight = self.create_parameter(
+            shape=[embed_dim, embed_dim], attr=linear_weight_attr)
+        self.linear_weight.is_distributed = True
+        self.linear_weight.dist_spec = P("mp", None)
+        self.linear_bias = self.create_parameter(
+            shape=[embed_dim], attr=linear_bias_attr, is_bias=True)
+        self.pre_ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter(
+            shape=[embed_dim], attr=pre_ln_bias_attr, is_bias=True)
+        self.ln_scale = self.create_parameter(
+            shape=[embed_dim], attr=ln_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln_bias = self.create_parameter(shape=[embed_dim], attr=ln_bias_attr,
+                                             is_bias=True)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        x = query
+        residual = x
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.embed_dim], self.pre_ln_scale,
+                             self.pre_ln_bias, self.epsilon)
+        b, s, _ = x.shape
+        qkv = _qkv_pack(x, self.qkv_weight, self.qkv_bias)  # [b,s,3,nh,hd]
+        q, k, v = qkv.unbind(axis=2)
+        if attn_mask is not None:
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, dropout_p=self.attn_dropout_rate,
+                training=self.training)
+        else:
+            out, _ = F.flash_attention(q, k, v, dropout=self.attn_dropout_rate,
+                                       causal=False, training=self.training)
+        out = out.reshape([b, s, self.embed_dim]).matmul(self.linear_weight)
+        out = out + self.linear_bias
+        out = F.dropout(out, p=self.dropout_rate, training=self.training)
+        out = residual + out
+        if not self.normalize_before:
+            out = F.layer_norm(out, [self.embed_dim], self.ln_scale, self.ln_bias,
+                               self.epsilon)
+        return out
+
+
+class FusedFeedForward(nn.Layer):
+    """LN + linear1 + act + dropout + linear2 + dropout + residual
+    (reference: fused_feedforward_op.cu — XLA fuses this chain natively)."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1, epsilon=1e-5,
+                 activation="relu", act_dropout_rate=None, normalize_before=False,
+                 linear1_weight_attr=None, linear1_bias_attr=None,
+                 linear2_weight_attr=None, linear2_bias_attr=None,
+                 ln1_scale_attr=None, ln1_bias_attr=None,
+                 ln2_scale_attr=None, ln2_bias_attr=None, nranks=1, ring_id=-1,
+                 name=None):
+        super().__init__()
+        self.d_model = d_model
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = dropout_rate if act_dropout_rate is None else act_dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            shape=[d_model, dim_feedforward], attr=linear1_weight_attr)
+        self.linear1_weight.is_distributed = True
+        self.linear1_weight.dist_spec = P(None, "mp")
+        self.linear1_bias = self.create_parameter(
+            shape=[dim_feedforward], attr=linear1_bias_attr, is_bias=True)
+        self.linear1_bias.is_distributed = True
+        self.linear1_bias.dist_spec = P("mp")
+        self.linear2_weight = self.create_parameter(
+            shape=[dim_feedforward, d_model], attr=linear2_weight_attr)
+        self.linear2_weight.is_distributed = True
+        self.linear2_weight.dist_spec = P("mp", None)
+        self.linear2_bias = self.create_parameter(
+            shape=[d_model], attr=linear2_bias_attr, is_bias=True)
+        self.ln1_scale = self.create_parameter(
+            shape=[d_model], attr=ln1_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln1_bias = self.create_parameter(shape=[d_model], attr=ln1_bias_attr,
+                                              is_bias=True)
+        self.ln2_scale = self.create_parameter(
+            shape=[d_model], attr=ln2_scale_attr,
+            default_initializer=nn.initializer.Constant(1.0))
+        self.ln2_bias = self.create_parameter(shape=[d_model], attr=ln2_bias_attr,
+                                              is_bias=True)
+
+    def forward(self, src):
+        residual = src
+        x = src
+        if self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln1_scale, self.ln1_bias,
+                             self.epsilon)
+        x = _act(self.activation)(x.matmul(self.linear1_weight) + self.linear1_bias)
+        x = F.dropout(x, p=self.act_dropout_rate, training=self.training)
+        x = x.matmul(self.linear2_weight) + self.linear2_bias
+        x = F.dropout(x, p=self.dropout_rate, training=self.training)
+        x = residual + x
+        if not self.normalize_before:
+            x = F.layer_norm(x, [self.d_model], self.ln2_scale, self.ln2_bias,
+                             self.epsilon)
+        return x
+
+
+def _qkv_pack(x, qkv_weight, qkv_bias):
+    """[b,s,H] × [3,nh,hd,H] (+[3,nh,hd]) → [b,s,3,nh,hd] — the packed-QKV
+    GEMM with the reference's trans_qkvw weight layout."""
+
+    def fn(xa, wa, ba):
+        out = jnp.einsum("bsh,tndh->bstnd", xa, wa.astype(xa.dtype))
+        if ba is not None:
+            out = out + ba.astype(xa.dtype)
+        return out
+
+    if qkv_bias is None:
+        return apply_op(lambda xa, wa: fn(xa, wa, None), x, qkv_weight)
+    return apply_op(fn, x, qkv_weight, qkv_bias)
+
+
+class FusedMultiTransformer(nn.Layer):
+    """Whole decoder stack as one layer (reference:
+    fused_multi_transformer_op.cu — "one call = ALL layers' params as tensor
+    lists", SURVEY.md §3.5). Pre-LN only, like the reference.
+
+    forward(src, caches=..., time_step=...) implements both phases:
+      * context (time_step None): causal flash attention over the full
+        prompt; writes k/v into the caches' first seq positions;
+      * decode (time_step int): one token per call, appends to cache at
+        time_step, attends via the Pallas decode kernel.
+    Caches are [2, bsz, num_heads, max_seq, head_dim] per layer and are
+    returned updated (functional update — in-place mutation is not a TPU
+    concept; callers thread them, reference semantics preserved).
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate=0.0,
+                 activation="gelu", normalize_before=True, ln_scale_attrs=None,
+                 ln_bias_attrs=None, qkv_weight_attrs=None, qkv_bias_attrs=None,
+                 linear_weight_attrs=None, linear_bias_attrs=None,
+                 ffn_ln_scale_attrs=None, ffn_ln_bias_attrs=None,
+                 ffn1_weight_attrs=None, ffn1_bias_attrs=None,
+                 ffn2_weight_attrs=None, ffn2_bias_attrs=None, epsilon=1e-5,
+                 num_layers=-1, nranks=1, trans_qkvw=True, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        assert normalize_before, "reference kernel is pre-LN only"
+        assert trans_qkvw, "only the [3,nh,hd,H] qkv layout is supported"
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dim_feedforward = dim_feedforward
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.epsilon = epsilon
+        if num_layers == -1:
+            num_layers = len(qkv_weight_attrs) if isinstance(qkv_weight_attrs, (list, tuple)) else 1
+        self.num_layers = num_layers
+
+        def attr_at(attrs, i):
+            return attrs[i] if isinstance(attrs, (list, tuple)) else attrs
+
+        self.ln_scales, self.ln_biases = [], []
+        self.qkv_weights, self.qkv_biases = [], []
+        self.linear_weights, self.linear_biases = [], []
+        self.ffn_ln_scales, self.ffn_ln_biases = [], []
+        self.ffn1_weights, self.ffn1_biases = [], []
+        self.ffn2_weights, self.ffn2_biases = [], []
+        h, nh, hd, ff = embed_dim, num_heads, self.head_dim, dim_feedforward
+        for i in range(num_layers):
+            ln_s = self.create_parameter([h], attr_at(ln_scale_attrs, i),
+                                         default_initializer=nn.initializer.Constant(1.0))
+            ln_b = self.create_parameter([h], attr_at(ln_bias_attrs, i), is_bias=True)
+            qkv_w = self.create_parameter([3, nh, hd, h], attr_at(qkv_weight_attrs, i),
+                                          default_initializer=nn.initializer.XavierNormal())
+            qkv_w.is_distributed = True
+            qkv_w.dist_spec = P(None, "mp", None, None)
+            qkv_b = self.create_parameter([3, nh, hd], attr_at(qkv_bias_attrs, i),
+                                          is_bias=True)
+            qkv_b.is_distributed = True
+            qkv_b.dist_spec = P(None, "mp", None)
+            lin_w = self.create_parameter([h, h], attr_at(linear_weight_attrs, i),
+                                          default_initializer=nn.initializer.XavierNormal())
+            lin_w.is_distributed = True
+            lin_w.dist_spec = P("mp", None)
+            lin_b = self.create_parameter([h], attr_at(linear_bias_attrs, i), is_bias=True)
+            fln_s = self.create_parameter([h], attr_at(ffn_ln_scale_attrs, i),
+                                          default_initializer=nn.initializer.Constant(1.0))
+            fln_b = self.create_parameter([h], attr_at(ffn_ln_bias_attrs, i), is_bias=True)
+            f1_w = self.create_parameter([h, ff], attr_at(ffn1_weight_attrs, i),
+                                         default_initializer=nn.initializer.XavierNormal())
+            f1_w.is_distributed = True
+            f1_w.dist_spec = P(None, "mp")
+            f1_b = self.create_parameter([ff], attr_at(ffn1_bias_attrs, i), is_bias=True)
+            f1_b.is_distributed = True
+            f1_b.dist_spec = P("mp")
+            f2_w = self.create_parameter([ff, h], attr_at(ffn2_weight_attrs, i),
+                                         default_initializer=nn.initializer.XavierNormal())
+            f2_w.is_distributed = True
+            f2_w.dist_spec = P("mp", None)
+            f2_b = self.create_parameter([h], attr_at(ffn2_bias_attrs, i), is_bias=True)
+
+            for name_, p in (
+                (f"ln_scales.{i}", ln_s), (f"ln_biases.{i}", ln_b),
+                (f"qkv_weights.{i}", qkv_w), (f"qkv_biases.{i}", qkv_b),
+                (f"linear_weights.{i}", lin_w), (f"linear_biases.{i}", lin_b),
+                (f"ffn_ln_scales.{i}", fln_s), (f"ffn_ln_biases.{i}", fln_b),
+                (f"ffn1_weights.{i}", f1_w), (f"ffn1_biases.{i}", f1_b),
+                (f"ffn2_weights.{i}", f2_w), (f"ffn2_biases.{i}", f2_b),
+            ):
+                self.add_parameter(name_.replace(".", "_"), p)
+            self.ln_scales.append(ln_s); self.ln_biases.append(ln_b)
+            self.qkv_weights.append(qkv_w); self.qkv_biases.append(qkv_b)
+            self.linear_weights.append(lin_w); self.linear_biases.append(lin_b)
+            self.ffn_ln_scales.append(fln_s); self.ffn_ln_biases.append(fln_b)
+            self.ffn1_weights.append(f1_w); self.ffn1_biases.append(f1_b)
+            self.ffn2_weights.append(f2_w); self.ffn2_biases.append(f2_b)
+
+    # ---- per-layer compute
+    def _attention(self, i, x, cache, time_step):
+        b, s, _ = x.shape
+        nh, hd = self.num_heads, self.head_dim
+        qkv = _qkv_pack(x, self.qkv_weights[i], self.qkv_biases[i])
+        q, k, v = qkv.unbind(axis=2)  # [b,s,nh,hd]
+        new_cache = None
+        if cache is None:
+            out, _ = F.flash_attention(q, k, v, causal=True, training=self.training)
+        elif time_step is None:
+            # context phase: write prompt k/v at positions [0, s)
+            from ....ops.pallas.decode_attention import cache_prefill_write
+
+            new_cache = apply_op(cache_prefill_write, cache, k, v)
+            out, _ = F.flash_attention(q, k, v, causal=True, training=self.training)
+        else:
+            # decode phase: append this token at time_step, attend over cache
+            from ....ops.pallas.decode_attention import cache_decode_step
+
+            out, new_cache = apply_op(
+                lambda c, qa, ka, va: cache_decode_step(c, qa, ka, va, time_step),
+                cache, q, k, v)
+        out = out.reshape([b, s, self.embed_dim])
+        out = out.matmul(self.linear_weights[i]) + self.linear_biases[i]
+        return out, new_cache
+
+    def _ffn(self, i, x):
+        h = _act(self.activation)(x.matmul(self.ffn1_weights[i]) + self.ffn1_biases[i])
+        return h.matmul(self.ffn2_weights[i]) + self.ffn2_biases[i]
+
+    def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
+                rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
+                time_step=None):
+        x = src
+        new_caches: List = []
+        for i in range(self.num_layers):
+            residual = x
+            ln = F.layer_norm(x, [self.embed_dim], self.ln_scales[i],
+                              self.ln_biases[i], self.epsilon)
+            attn, new_c = self._attention(
+                i, ln, None if caches is None else caches[i], time_step)
+            if caches is not None:
+                new_caches.append(new_c if new_c is not None else caches[i])
+            x = residual + attn
+            residual = x
+            ln2 = F.layer_norm(x, [self.embed_dim], self.ffn_ln_scales[i],
+                               self.ffn_ln_biases[i], self.epsilon)
+            x = residual + self._ffn(i, ln2)
+        if caches is not None:
+            return x, new_caches
+        return x
+
+
+class FusedTransformerEncoderLayer(nn.Layer):
+    """Reference: FusedTransformerEncoderLayer = FusedMultiHeadAttention +
+    FusedFeedForward."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate if attn_dropout_rate is None else attn_dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -21,6 +21,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
+import jax
 import jax.numpy as jnp
 
 from .. import nn
@@ -84,17 +85,35 @@ class GPTAttention(nn.Layer):
         self.qkv_proj = nn.Linear(h, 3 * h)
         self.out_proj = nn.Linear(h, h)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, time_step=None):
         b, s, h = x.shape
         qkv = self.qkv_proj(x)  # [b, s, 3h]
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)  # each [b, s, nh, hd]
-        out, _ = F.flash_attention(
-            q, k, v, dropout=self.attn_dropout, causal=True,
-            training=self.training,
-        )
+        new_cache = None
+        if cache is None:
+            out, _ = F.flash_attention(
+                q, k, v, dropout=self.attn_dropout, causal=True,
+                training=self.training,
+            )
+        elif time_step is None:
+            # prefill: causal attention over the prompt, cache k/v at [0, s)
+            from ..ops.pallas.decode_attention import cache_prefill_write
+
+            new_cache = apply_op(cache_prefill_write, cache, k, v)
+            out, _ = F.flash_attention(q, k, v, causal=True, training=False)
+        else:
+            # decode: one token, Pallas decode kernel over the cache
+            from ..ops.pallas.decode_attention import cache_decode_step
+
+            out, new_cache = apply_op(
+                lambda c, qa, ka, va: cache_decode_step(c, qa, ka, va, time_step),
+                cache, q, k, v)
         out = out.reshape([b, s, h])
-        return self.out_proj(out)
+        out = self.out_proj(out)
+        if cache is not None:
+            return out, new_cache
+        return out
 
 
 class GPTMLP(nn.Layer):
@@ -116,10 +135,15 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(config)
         self.dropout = nn.Dropout(config.hidden_dropout)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
-        x = x + self.dropout(self.mlp(self.ln_2(x)))
-        return x
+    def forward(self, x, cache=None, time_step=None):
+        if cache is None:
+            x = x + self.dropout(self.attn(self.ln_1(x)))
+            x = x + self.dropout(self.mlp(self.ln_2(x)))
+            return x
+        attn, new_cache = self.attn(self.ln_1(x), cache=cache, time_step=time_step)
+        x = x + attn
+        x = x + self.mlp(self.ln_2(x))
+        return x, new_cache
 
 
 class GPTModel(nn.Layer):
@@ -135,14 +159,28 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, time_step=None):
         b, s = input_ids.shape
-        pos = Tensor._wrap(jnp.arange(s, dtype=jnp.int32)[None, :])
+        offset = 0 if time_step is None else time_step
+        pos = Tensor._wrap(jnp.arange(s, dtype=jnp.int32)[None, :] + offset)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for block in self.h:
-            x = block(x)
-        return self.ln_f(x)
+        if caches is None:
+            for block in self.h:
+                x = block(x)
+            return self.ln_f(x)
+        new_caches = []
+        for block, cache in zip(self.h, caches):
+            x, nc = block(x, cache=cache, time_step=time_step)
+            new_caches.append(nc)
+        return self.ln_f(x), new_caches
+
+    def init_caches(self, batch_size, max_seq, dtype=jnp.float32):
+        """KV caches, reference layout [2, bsz, nh, max_seq, hd] per layer
+        (fused_multi_transformer_op.cu cache layout)."""
+        cfg = self.config
+        shape = (2, batch_size, cfg.num_heads, max_seq, cfg.head_dim)
+        return [Tensor._wrap(jnp.zeros(shape, dtype)) for _ in range(cfg.num_layers)]
 
 
 class GPTForCausalLM(nn.Layer):
@@ -153,10 +191,75 @@ class GPTForCausalLM(nn.Layer):
         self.config = config
         self.gpt = GPTModel(config)
 
-    def forward(self, input_ids):
-        x = self.gpt(input_ids)
+    def forward(self, input_ids, caches=None, time_step=None):
+        if caches is None:
+            x = self.gpt(input_ids)
+            return self._logits(x)
+        x, new_caches = self.gpt(input_ids, caches=caches, time_step=time_step)
+        return self._logits(x), new_caches
+
+    def _logits(self, x):
         w = self.gpt.wte.weight
         return apply_op(lambda a, we: jnp.einsum("bsh,vh->bsv", a, we.astype(a.dtype)), x, w)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
+                 seed=0, max_seq=None):
+        """Autoregressive generation over the KV cache (reference capability:
+        FusedMultiTransformer decode path, fused_multi_transformer_op.cu —
+        prefill once, then one decode-kernel step per token).
+
+        Greedy when temperature==0 (or top_k==1); otherwise samples from the
+        (optionally top-k-truncated) softmax. Returns [B, prompt+new] ids.
+        """
+        from ..framework.tensor import no_grad
+
+        was_training = self.training
+        self.eval()
+        try:
+            with no_grad():
+                return self._generate(input_ids, max_new_tokens, temperature,
+                                      top_k, seed, max_seq)
+        finally:
+            if was_training:
+                self.train()
+
+    def _generate(self, input_ids, max_new_tokens, temperature, top_k, seed,
+                  max_seq):
+        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+        b, prompt = ids.shape
+        total = max_seq or min(self.config.max_position, prompt + max_new_tokens)
+        caches = self.gpt.init_caches(b, total)
+
+        logits, caches = self.forward(Tensor._wrap(ids), caches=caches)
+        key = jax.random.key(seed)
+        out = ids
+
+        def pick(logits_last, key):
+            if temperature == 0.0 or top_k == 1:
+                return jnp.argmax(logits_last, axis=-1).astype(ids.dtype)
+            lg = logits_last / max(temperature, 1e-6)
+            if top_k > 1:
+                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            return jax.random.categorical(key, lg, axis=-1).astype(ids.dtype)
+
+        lg = logits._data if isinstance(logits, Tensor) else logits
+        key, sub = jax.random.split(key)
+        nxt = pick(lg[:, -1], sub)
+        out = jnp.concatenate([out, nxt[:, None]], axis=1)
+
+        # decode: token emitted after prefill sits at position `prompt`;
+        # step t writes its kv at cache slot t and predicts token t+1
+        for t in range(prompt, total - 1):
+            if out.shape[1] >= prompt + max_new_tokens:
+                break
+            logits, caches = self.forward(
+                Tensor._wrap(out[:, -1:]), caches=caches, time_step=t)
+            lg = logits._data if isinstance(logits, Tensor) else logits
+            key, sub = jax.random.split(key)
+            nxt = pick(lg[:, -1], sub)
+            out = jnp.concatenate([out, nxt[:, None]], axis=1)
+        return Tensor._wrap(out)
 
     def loss(self, input_ids, labels):
         logits = self.forward(input_ids)

@@ -7,4 +7,9 @@ numerics tests (tests/test_flash_attention.py etc.). On non-TPU backends the
 kernels run in Pallas interpret mode so CI (8 virtual CPU devices) covers
 them.
 """
+from .decode_attention import (
+    decode_attention,
+    decode_attention_pallas,
+    decode_attention_ref,
+)
 from .flash_attention import flash_attention_fused

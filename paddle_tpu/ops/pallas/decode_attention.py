@@ -1,0 +1,181 @@
+"""Pallas decode attention with KV cache (generation hot loop).
+
+TPU-native equivalent of the reference's masked_multihead_attention CUDA
+kernel (paddle/phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu;
+invoked per-layer by fused_multi_transformer_op.cu in decode phase, one CTA
+per (batch, head)). Here: one Pallas grid instance per (batch, head) reading
+that head's whole cache row from HBM into VMEM, masking positions beyond the
+batch element's current length (scalar-prefetched), and producing one output
+row. Logits/softmax in fp32; the QK^T and PV contractions are MXU dots.
+
+Layouts
+  q               [B, H, D]        — the single new token's heads
+  k_cache/v_cache [B, H, S, D]     — S = max_seq (static), cache layout
+                                     matching the reference's
+                                     [2, bsz, nh, max_seq, dh] split in two
+  lengths         [B] int32        — valid entries INCLUDING the new token
+                                     (already written at lengths-1)
+
+GQA: H_kv may divide H; q head h reads kv head h // (H // H_kv).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1.0e30
+_Q_ROWS = 8  # pad the single q row to a full sublane tile
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, scale, max_seq):
+    b = pl.program_id(0)
+    length = len_ref[b]
+
+    q = q_ref[0].astype(jnp.float32)  # [_Q_ROWS, D] (row 0 is real)
+    k = k_ref[0, 0]  # [S, D]
+    s = jax.lax.dot_general(
+        q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [_Q_ROWS, S]
+
+    ids = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(ids < length, s, NEG_INF)
+
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    out = jax.lax.dot_general(
+        p, v_ref[0, 0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / jnp.maximum(l, 1e-37)  # [_Q_ROWS, D]
+    o_ref[0] = out.astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k_cache, v_cache, lengths, scale=None):
+    """q [B,H,D], caches [B,Hkv,S,D], lengths [B] → [B,H,D]."""
+    b, h, d = q.shape
+    h_kv, s_max = k_cache.shape[1], k_cache.shape[2]
+    group = h // h_kv
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    dpad = (128 - d % 128) % 128
+    spad = (8 - s_max % 8) % 8
+    if dpad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, dpad)))
+    if dpad or spad:
+        k_cache = jnp.pad(k_cache, ((0, 0), (0, 0), (0, spad), (0, dpad)))
+        v_cache = jnp.pad(v_cache, ((0, 0), (0, 0), (0, spad), (0, dpad)))
+    dp = d + dpad
+
+    # [B,H,D] -> [B*H, _Q_ROWS, D] with the real row broadcast (row 0 used)
+    qr = jnp.broadcast_to(q.reshape(b * h, 1, dp), (b * h, _Q_ROWS, dp))
+
+    grid = (b, h)
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, max_seq=s_max),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, _Q_ROWS, dp),
+                             lambda i, j, lens: (i * h + j, 0, 0)),
+                pl.BlockSpec((1, 1, s_max + spad, dp),
+                             lambda i, j, lens: (i, j // group, 0, 0)),
+                pl.BlockSpec((1, 1, s_max + spad, dp),
+                             lambda i, j, lens: (i, j // group, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, _Q_ROWS, dp),
+                                   lambda i, j, lens: (i * h + j, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, _Q_ROWS, dp), q.dtype),
+        interpret=_interpret(),
+    )(jnp.asarray(lengths, jnp.int32), qr, k_cache, v_cache)
+    return out[:, 0, :d].reshape(b, h, d)
+
+
+def decode_attention_ref(q, k_cache, v_cache, lengths, scale=None):
+    """Pure-jax twin of the kernel (also the CPU fallback)."""
+    b, h, d = q.shape
+    h_kv, s_max = k_cache.shape[1], k_cache.shape[2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if h_kv != h:
+        rep = h // h_kv
+        k_cache = jnp.repeat(k_cache, rep, axis=1)
+        v_cache = jnp.repeat(v_cache, rep, axis=1)
+    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32),
+                   k_cache.astype(jnp.float32)) * scale
+    ids = jnp.arange(s_max)[None, None, :]
+    s = jnp.where(ids < jnp.asarray(lengths)[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bhsd->bhd", p, v_cache.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _decode_dispatch(q, k_cache, v_cache, lengths, scale):
+    if jax.default_backend() == "tpu":
+        return decode_attention_pallas(q, k_cache, v_cache, lengths, scale)
+    return decode_attention_ref(q, k_cache, v_cache, lengths, scale)
+
+
+def _decode_fwd(q, k_cache, v_cache, lengths, scale):
+    return _decode_dispatch(q, k_cache, v_cache, lengths, scale), (q, k_cache, v_cache, lengths)
+
+
+def _decode_bwd(scale, res, g):
+    # gradient through the differentiable jnp twin — decode attention is an
+    # inference kernel, so bwd is a rarely-hit correctness fallback, not a
+    # perf path (training uses the flash kernel's fused bwd)
+    q, k_cache, v_cache, lengths = res
+    _, vjp = jax.vjp(lambda a, b, c: decode_attention_ref(a, b, c, lengths, scale),
+                     q, k_cache, v_cache)
+    dq, dk, dv = vjp(g)
+    return dq, dk, dv, None
+
+
+_decode_dispatch.defvjp(_decode_fwd, _decode_bwd)
+
+
+def decode_attention(q, k_cache, v_cache, lengths, scale=None):
+    """Dispatch: Pallas on TPU, reference math elsewhere (interpret mode is
+    exact but slow; eager CPU tests use the jnp twin directly).
+    Differentiable: bwd routes through the jnp twin via custom_vjp."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _decode_dispatch(q, k_cache, v_cache, jnp.asarray(lengths), scale)
+
+
+# ------------------------------------------------- shared cache plumbing
+# One implementation of the cache write/step dataflow, used by both the GPT
+# model family and the incubate FusedMultiTransformer (review: keep the two
+# decode paths from diverging).
+
+
+def cache_prefill_write(cache, k, v):
+    """Write prompt k/v ([b,s,nh,hd]) into cache [2,b,nh,S,hd] at [0, s)."""
+    upd = jnp.stack([jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)])
+    return jax.lax.dynamic_update_slice(cache, upd.astype(cache.dtype),
+                                        (0, 0, 0, 0, 0))
+
+
+def cache_decode_step(cache, q, k, v, time_step, scale=None):
+    """Append one token's k/v ([b,1,nh,hd]) at ``time_step`` and attend q
+    over the cache. Returns (out [b,1,nh,hd], new_cache)."""
+    ts = jnp.asarray(time_step, jnp.int32).reshape(())
+    upd = jnp.stack([jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2)])  # [2,b,nh,1,hd]
+    cache = jax.lax.dynamic_update_slice(cache, upd.astype(cache.dtype),
+                                         (0, 0, 0, ts, 0))
+    lengths = jnp.full((q.shape[0],), ts + 1, jnp.int32)
+    qh = jnp.swapaxes(q, 1, 2)[:, :, 0]  # [b,nh,hd]
+    out = decode_attention(qh, cache[0], cache[1], lengths, scale)
+    return out[:, None], cache

@@ -1,0 +1,51 @@
+"""paddle_tpu.distributed — public distributed API surface.
+
+Reference: python/paddle/distributed/__init__.py (collectives, parallel env,
+fleet, sharding, launch).
+"""
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    fcollectives,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    get_mesh,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    new_group,
+    set_mesh,
+)
+from .topology import (  # noqa: F401
+    HYBRID_AXES,
+    CommunicateTopology,
+    Group,
+    HybridCommunicateGroup,
+    build_mesh,
+)
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+
+__all__ = [
+    "ReduceOp", "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "reduce", "scatter", "all_to_all", "reduce_scatter", "barrier", "send",
+    "recv", "fcollectives", "DataParallel", "ParallelEnv", "get_rank",
+    "get_world_size", "init_parallel_env", "is_initialized", "new_group",
+    "get_mesh", "set_mesh", "fleet", "sharding", "group_sharded_parallel",
+    "save_group_sharded_model", "build_mesh", "Group",
+    "CommunicateTopology", "HybridCommunicateGroup", "HYBRID_AXES",
+]

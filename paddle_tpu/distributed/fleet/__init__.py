@@ -20,4 +20,6 @@ from .fleet_base import (  # noqa: F401
     worker_num,
 )
 from . import meta_parallel  # noqa: F401
+from . import meta_optimizers  # noqa: F401
+from .meta_optimizers import HybridParallelOptimizer  # noqa: F401
 from .utils import log_util  # noqa: F401

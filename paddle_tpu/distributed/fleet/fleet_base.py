@@ -122,11 +122,18 @@ def distributed_model(model):
 
 def distributed_optimizer(optimizer, strategy=None):
     """Wrap the optimizer with hybrid-aware glue (reference:
-    HybridParallelOptimizer, fleet/meta_parallel/../hybrid_parallel_optimizer.py):
-    distributed global-norm clipping + found_inf reduction happen inside the
-    compiled step, so the wrapper mainly records the hcg for those policies."""
+    fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py):
+    distributed global-norm clip + replicated-grad sync, plus stage-1
+    sharded optimizer state when sharding_degree > 1."""
     if not fleet_state.initialized:
         raise RuntimeError("call fleet.init() first")
     optimizer._hcg = fleet_state.hcg
     optimizer._mesh = fleet_state.mesh
-    return optimizer
+    from .meta_optimizers import HybridParallelOptimizer
+    from ..sharding.sharding_optimizer import DygraphShardingOptimizer
+
+    if fleet_state.topology.get_dim("sharding") > 1:
+        optimizer = DygraphShardingOptimizer(
+            optimizer, hcg=fleet_state.hcg, mesh=fleet_state.mesh
+        )
+    return HybridParallelOptimizer(optimizer, fleet_state.hcg, fleet_state.strategy)

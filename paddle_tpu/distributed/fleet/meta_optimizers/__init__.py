@@ -1,0 +1,14 @@
+"""Fleet meta-optimizers (reference: python/paddle/distributed/fleet/
+meta_optimizers/ — the static-graph rewrites are subsumed by compiled SPMD;
+what survives is the dygraph hybrid optimizer glue)."""
+from .dygraph_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    HybridParallelGradScaler,
+    HybridParallelOptimizer,
+)
+
+__all__ = [
+    "HybridParallelOptimizer",
+    "HybridParallelGradScaler",
+    "DygraphShardingOptimizer",
+]

@@ -1,0 +1,29 @@
+"""paddle_tpu.distributed.sharding — GroupSharded / ZeRO over the mesh.
+
+Reference: python/paddle/distributed/sharding/__init__.py.
+"""
+from .group_sharded import (  # noqa: F401
+    GroupShardedModel,
+    add_sharding_axis,
+    group_sharded_parallel,
+    save_group_sharded_model,
+    shard_grads,
+    shard_optimizer_states,
+    sharded_specs_for_params,
+)
+from .sharding_optimizer import (  # noqa: F401
+    DygraphShardingOptimizer,
+    ShardedOptimizer,
+)
+
+__all__ = [
+    "group_sharded_parallel",
+    "save_group_sharded_model",
+    "GroupShardedModel",
+    "ShardedOptimizer",
+    "DygraphShardingOptimizer",
+    "add_sharding_axis",
+    "sharded_specs_for_params",
+    "shard_optimizer_states",
+    "shard_grads",
+]

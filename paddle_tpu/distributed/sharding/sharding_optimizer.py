@@ -1,0 +1,114 @@
+"""Sharded optimizer wrappers (ZeRO stage 1 eager path + functional specs).
+
+Reference: python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/
+dygraph_sharding_optimizer.py (``DygraphShardingOptimizer`` — partitions the
+param list across sharding ranks; each rank updates its shard then broadcasts)
+and fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py.
+
+TPU-native: there is no rank-local partition of a Python param list — the
+optimizer state *arrays* are placed sharded over the ``sharding`` mesh axis
+and XLA partitions the update computation. The wrapper keeps the reference's
+API (``step``, ``clear_grad``, ``state_dict``) and its semantics (each device
+holds 1/N of the moments + master weights; updated params come back whole).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .group_sharded import add_sharding_axis
+
+__all__ = ["ShardedOptimizer", "DygraphShardingOptimizer"]
+
+
+class ShardedOptimizer:
+    """Delegating wrapper placing optimizer state sharded over ``sharding``.
+
+    Works for any ``paddle_tpu.optimizer.Optimizer``. For the compiled path,
+    use :func:`paddle_tpu.distributed.sharding.shard_optimizer_states` on the
+    ``init_state_tree`` output instead.
+    """
+
+    def __init__(self, inner, model=None, mesh=None, level: str = "os",
+                 offload: bool = False, axis: str = "sharding"):
+        self._inner = inner
+        self._model = model
+        self._level = level
+        self._offload = offload
+        self._axis = axis
+        if mesh is None:
+            from ..parallel import get_mesh
+
+            mesh = get_mesh()
+        self._mesh = mesh
+        self._placed = False
+
+    # -- placement ----------------------------------------------------------
+    def _sharding_for(self, p):
+        base = getattr(p, "dist_spec", None)
+        spec = add_sharding_axis(tuple(p.shape), base, self._mesh, self._axis)
+        sh = NamedSharding(self._mesh, spec)
+        if self._offload:
+            try:
+                sh = sh.with_memory_kind("pinned_host")
+            except Exception:
+                pass  # backend without host memory space: keep device placement
+        return sh
+
+    def _ensure_placed(self):
+        """Create + place accumulators/master weights sharded, once."""
+        if self._placed:
+            return
+        inner = self._inner
+        for p in inner._parameter_list():
+            state = inner._state_for(p)
+            sh = self._sharding_for(p)
+            for k, v in list(state.items()):
+                state[k] = jax.device_put(v, sh)
+            pid = id(p)
+            if pid in inner._master_weights:
+                inner._master_weights[pid] = jax.device_put(
+                    inner._master_weights[pid], sh
+                )
+        self._placed = True
+
+    # -- optimizer API ------------------------------------------------------
+    def step(self):
+        self._ensure_placed()
+        self._inner.step()
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def get_lr(self):
+        return self._inner.get_lr()
+
+    def set_lr(self, v):
+        return self._inner.set_lr(v)
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, st):
+        return self._inner.set_state_dict(st)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner"], item)
+
+
+class DygraphShardingOptimizer(ShardedOptimizer):
+    """Stage-1 alias with the reference's constructor shape
+    (dygraph_sharding_optimizer.py: (optimizer, hcg))."""
+
+    def __init__(self, optimizer, hcg=None, **kwargs):
+        super().__init__(optimizer, level="os", **kwargs)
+        self._hcg = hcg

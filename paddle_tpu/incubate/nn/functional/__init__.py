@@ -37,9 +37,9 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
         x = F.layer_norm(x, [d], ln1_scale, ln1_bias, ln1_epsilon)
     act = {"gelu": lambda a: F.gelu(a, approximate=True), "relu": F.relu}[activation]
     h = act(x.matmul(linear1_weight) + (linear1_bias if linear1_bias is not None else 0))
-    h = F.dropout(h, p=dropout1_rate, training=training)
+    h = F.dropout(h, p=dropout1_rate, training=training, mode=mode)
     h = h.matmul(linear2_weight) + (linear2_bias if linear2_bias is not None else 0)
-    h = F.dropout(h, p=dropout2_rate, training=training)
+    h = F.dropout(h, p=dropout2_rate, training=training, mode=mode)
     out = residual + h
     if not pre_layer_norm:
         out = F.layer_norm(out, [d], ln2_scale, ln2_bias, ln2_epsilon)
@@ -58,6 +58,12 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     from ....nn import functional as F
     from ..layer.fused_transformer import _qkv_pack
 
+    if cache_kv is not None:
+        raise NotImplementedError(
+            "fused_multi_head_attention: cache_kv (incremental decode) is not "
+            "supported here — use masked_multihead_attention or "
+            "FusedMultiTransformer's cache path; silently dropping it would "
+            "compute non-cached attention and a stale cache")
     residual = x
     d = x.shape[-1]
     if pre_layer_norm:
@@ -75,7 +81,7 @@ def fused_multi_head_attention(x, qkv_weight, linear_weight, pre_layer_norm=Fals
     out = out.reshape([b, s, d]).matmul(linear_weight)
     if linear_bias is not None:
         out = out + linear_bias
-    out = F.dropout(out, p=dropout_rate, training=training)
+    out = F.dropout(out, p=dropout_rate, training=training, mode=mode)
     if add_residual:
         out = residual + out
     if not pre_layer_norm:

@@ -89,6 +89,11 @@ class FusedMultiHeadAttention(nn.Layer):
                                              is_bias=True)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        if cache is not None:
+            raise NotImplementedError(
+                "FusedMultiHeadAttention: cache (incremental decode) is not "
+                "supported — use FusedMultiTransformer's caches/time_step path; "
+                "silently dropping it would compute non-cached attention")
         x = query
         residual = x
         if self.normalize_before:
@@ -287,20 +292,30 @@ class FusedMultiTransformer(nn.Layer):
             self.ffn2_weights.append(f2_w); self.ffn2_biases.append(f2_b)
 
     # ---- per-layer compute
-    def _attention(self, i, x, cache, time_step):
+    def _attention(self, i, x, cache, time_step, attn_mask=None):
         b, s, _ = x.shape
         nh, hd = self.num_heads, self.head_dim
         qkv = _qkv_pack(x, self.qkv_weights[i], self.qkv_biases[i])
         q, k, v = qkv.unbind(axis=2)  # [b,s,nh,hd]
         new_cache = None
+
+        def ctx_attention():
+            # reference semantics: attn_mask (when given) already encodes
+            # causality + padding, so it replaces the built-in causal mask
+            if attn_mask is not None:
+                return F.scaled_dot_product_attention(
+                    q, k, v, attn_mask=attn_mask, dropout_p=0.0, training=False)
+            return F.flash_attention(q, k, v, causal=True,
+                                     training=self.training)[0]
+
         if cache is None:
-            out, _ = F.flash_attention(q, k, v, causal=True, training=self.training)
+            out = ctx_attention()
         elif time_step is None:
             # context phase: write prompt k/v at positions [0, s)
             from ....ops.pallas.decode_attention import cache_prefill_write
 
             new_cache = apply_op(cache_prefill_write, cache, k, v)
-            out, _ = F.flash_attention(q, k, v, causal=True, training=self.training)
+            out = ctx_attention()
         else:
             # decode phase: append this token at time_step, attend over cache
             from ....ops.pallas.decode_attention import cache_decode_step
@@ -319,6 +334,19 @@ class FusedMultiTransformer(nn.Layer):
     def forward(self, src, attn_mask=None, caches=None, pre_caches=None,
                 rotary_embs=None, rotary_emb_dims=0, seq_lens=None,
                 time_step=None):
+        unsupported = {"pre_caches": pre_caches, "rotary_embs": rotary_embs,
+                       "seq_lens": seq_lens}
+        bad = [k for k, v in unsupported.items() if v is not None]
+        if rotary_emb_dims:
+            bad.append("rotary_emb_dims")
+        if bad:
+            raise NotImplementedError(
+                f"FusedMultiTransformer: unsupported arguments {bad} — "
+                "silently dropping them would compute wrong attention")
+        if attn_mask is not None and time_step is not None:
+            raise NotImplementedError(
+                "FusedMultiTransformer: attn_mask in the decode phase is not "
+                "supported (the decode kernel masks by sequence length)")
         x = src
         new_caches: List = []
         for i in range(self.num_layers):
@@ -326,7 +354,8 @@ class FusedMultiTransformer(nn.Layer):
             ln = F.layer_norm(x, [self.embed_dim], self.ln_scales[i],
                               self.ln_biases[i], self.epsilon)
             attn, new_c = self._attention(
-                i, ln, None if caches is None else caches[i], time_step)
+                i, ln, None if caches is None else caches[i], time_step,
+                attn_mask=attn_mask)
             if caches is not None:
                 new_caches.append(new_c if new_c is not None else caches[i])
             x = residual + attn

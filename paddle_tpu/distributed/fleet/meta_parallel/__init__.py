@@ -16,9 +16,12 @@ from .random import (
     model_parallel_random_seed,
 )
 from .tensor_parallel import TensorParallel, apply_dist_specs, param_shardings
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
+from .pipeline_engine import PipelineParallel
 
 __all__ = [
     "MetaParallelBase",
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "parallel_cross_entropy_shardmap",
     "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",

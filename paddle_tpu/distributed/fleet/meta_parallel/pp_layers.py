@@ -1,0 +1,236 @@
+"""Pipeline-parallel model authoring (reference: python/paddle/distributed/
+fleet/meta_parallel/parallel_layers/pp_layers.py — LayerDesc, SharedLayerDesc,
+PipelineLayer).
+
+TPU-native stance (SURVEY.md §7 hard part #1): the reference materializes only
+the local stage's layers per process and moves activations with NCCL p2p.
+Under single-controller SPMD every process sees the global (sharded) params,
+so ``PipelineLayer`` materializes the whole model and *classifies* it for the
+compiled schedule:
+
+* ``pre_net``   — leading non-repeated layers (embeddings, …): computed
+  replicated over the ``pp`` mesh axis (cheap, runs once per microbatch tick
+  outside the pipelined region — the standard scan-over-layers idiom).
+* ``body``      — the maximal run of structurally-identical layers (the
+  transformer blocks). Their parameters are stacked ``[pp, layers_per_stage,
+  …]`` and sharded over ``'pp'``; the engine runs them under ``shard_map``
+  with ``ppermute`` activation rotation (pipeline_engine.py).
+* ``post_net``  — trailing non-repeated layers (final LN, LM head).
+
+``seg_method`` ("uniform" / "layer:ClassName") controls how body layers are
+divided among stages, mirroring the reference's segmentation; the body length
+must divide evenly by ``num_stages``.
+
+Tied weights (``SharedLayerDesc``) reuse the *same* Parameter object across
+occurrences, so the reference's cross-stage allreduce of shared-embedding
+grads (hybrid_parallel_shared_weight.py) is unnecessary: both uses read one
+array and autodiff sums the contributions.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional, Sequence
+
+from .... import nn
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Lazy layer constructor (reference: pp_layers.LayerDesc)."""
+
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        if not issubclass(layer_cls, nn.Layer):
+            raise TypeError(f"LayerDesc expects an nn.Layer subclass, got {layer_cls}")
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> nn.Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({self.layer_cls.__name__})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer whose ``shared_weight_attr`` parameter is tied across all descs
+    with the same ``key`` (reference: pp_layers.SharedLayerDesc — tied
+    input/output embeddings)."""
+
+    def __init__(self, key, layer_cls, *inputs, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class _SharedLayerProxy(nn.Layer):
+    """Materialized stand-in for a later occurrence of a SharedLayerDesc: owns
+    no parameters, borrows the master layer and applies ``forward_func``."""
+
+    def __init__(self, master: nn.Layer, desc: SharedLayerDesc):
+        super().__init__()
+        object.__setattr__(self, "_master", master)  # not a sublayer: no params
+        self._forward_func = desc.forward_func
+        self._attr = desc.shared_weight_attr
+
+    @property
+    def shared_weight(self):
+        return getattr(self._master, self._attr)
+
+    def forward(self, *args, **kwargs):
+        if self._forward_func is not None:
+            return self._forward_func(self._master, *args, **kwargs)
+        return self._master(*args, **kwargs)
+
+
+def _param_signature(layer: nn.Layer):
+    """Structural identity: class + named param/buffer shapes+dtypes."""
+    params = tuple(
+        (name, tuple(p.shape), str(p.dtype)) for name, p in layer.named_parameters()
+    )
+    bufs = tuple(
+        (name, tuple(b.shape), str(b.dtype))
+        for name, b in layer.named_buffers()
+        if b is not None
+    )
+    return (type(layer).__name__, params, bufs)
+
+
+class PipelineLayer(nn.Layer):
+    """Pipeline model container (reference: pp_layers.PipelineLayer).
+
+    Accepts the reference's authoring surface — a flat list of
+    ``LayerDesc``/``SharedLayerDesc``/``nn.Layer``/callables plus
+    ``num_stages``, ``loss_fn``, ``seg_method`` — and additionally performs the
+    pre/body/post classification the compiled TPU schedule needs.
+    """
+
+    def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 num_virtual_pipeline_stages: Optional[int] = None):
+        super().__init__()
+        if num_stages is None and topology is not None:
+            num_stages = topology.get_dim("pp")
+        if num_stages is None:
+            num_stages = 1
+        self._num_stages = int(num_stages)
+        self._loss_fn = loss_fn
+        self._seg_method = seg_method
+        self._recompute_interval = int(recompute_interval)
+        self._topology = topology
+        if num_virtual_pipeline_stages not in (None, 1):
+            raise NotImplementedError(
+                "interleaved/virtual pipeline stages: planned (reference "
+                "PipelineParallelWithInterleave); the compiled 1F1B-equivalent "
+                "schedule subsumes most of its bubble win"
+            )
+
+        self._descs = list(layers)
+        self._shared_masters = {}  # key -> materialized master layer
+        run_list = nn.LayerList()
+        self._forward_funcs: List[Optional[Callable]] = []
+        for desc in self._descs:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared_masters:
+                    layer = _SharedLayerProxy(
+                        self._shared_masters[desc.layer_name], desc
+                    )
+                else:
+                    layer = desc.build_layer()
+                    self._shared_masters[desc.layer_name] = layer
+            elif isinstance(desc, LayerDesc):
+                layer = desc.build_layer()
+            elif isinstance(desc, nn.Layer):
+                layer = desc
+            elif callable(desc):
+                layer = _FuncLayer(desc)
+            else:
+                raise TypeError(f"PipelineLayer: bad layer entry {desc!r}")
+            run_list.append(layer)
+        self.run_function = run_list
+
+        self._classify()
+
+    # ---------------------------------------------------------------- layout
+    def _body_candidates(self):
+        """Index range [start, stop) of the maximal homogeneous run."""
+        sigs = [_param_signature(l) for l in self.run_function]
+        best = (0, 0)
+        i = 0
+        n = len(sigs)
+        while i < n:
+            j = i
+            while j < n and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1] - best[0]:
+                best = (i, j)
+            i = j
+        return best
+
+    def _classify(self):
+        start, stop = self._body_candidates()
+        if self._seg_method.startswith("layer:"):
+            cls_name = self._seg_method.split(":", 1)[1]
+            idx = [i for i, l in enumerate(self.run_function)
+                   if type(l).__name__ == cls_name]
+            if idx:
+                start, stop = idx[0], idx[-1] + 1
+        n_body = stop - start
+        if self._num_stages > 1:
+            if n_body == 0 or n_body % self._num_stages != 0:
+                raise ValueError(
+                    f"PipelineLayer: homogeneous body of {n_body} layers "
+                    f"(indices [{start},{stop})) is not divisible by "
+                    f"num_stages={self._num_stages}; pad the block count or "
+                    f"change seg_method (got {self._seg_method!r})"
+                )
+        self._body_range = (start, stop)
+
+    @property
+    def pre_layers(self) -> List[nn.Layer]:
+        return list(self.run_function)[: self._body_range[0]]
+
+    @property
+    def body_layers(self) -> List[nn.Layer]:
+        return list(self.run_function)[self._body_range[0]: self._body_range[1]]
+
+    @property
+    def post_layers(self) -> List[nn.Layer]:
+        return list(self.run_function)[self._body_range[1]:]
+
+    @property
+    def layers_per_stage(self) -> int:
+        return len(self.body_layers) // max(1, self._num_stages)
+
+    def get_num_stages(self) -> int:
+        return self._num_stages
+
+    def segment_describe(self) -> str:
+        a, b = self._body_range
+        return (f"pre[0:{a}] body[{a}:{b}]×{self._num_stages}stages "
+                f"post[{b}:{len(self.run_function)}]")
+
+    # --------------------------------------------------------------- forward
+    def forward(self, *args, **kwargs):
+        """Sequential (non-pipelined) forward — the numerical twin of the
+        compiled schedule; also the eval/export path."""
+        x = args[0] if len(args) == 1 else args
+        for layer in self.run_function:
+            x = layer(x) if not isinstance(x, tuple) else layer(*x)
+        return x
+
+
+class _FuncLayer(nn.Layer):
+    """Wraps a bare callable used as a pipeline step (reference allows
+    functions in the layer list)."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)

@@ -1,0 +1,342 @@
+"""Pipeline-parallel twin tests (SURVEY.md §4.3/§4.5: the reference's
+hybrid_parallel_pp_layer.py pattern — pp=N compiled schedule must match the
+single-process sequential run to tight tolerance, per step)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+)
+from paddle_tpu.framework.tensor import Tensor
+
+
+H = 16
+VOCAB = 37
+SEQ = 8
+
+
+class EmbedPipe(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.word = nn.Embedding(VOCAB, H)
+
+    def forward(self, x):
+        return self.word(x)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(H)
+        self.fc1 = nn.Linear(H, 4 * H)
+        self.fc2 = nn.Linear(4 * H, H)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+
+class HeadPipe(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(H)
+        self.proj = nn.Linear(H, VOCAB)
+
+    def forward(self, x):
+        return self.proj(self.ln(x))
+
+
+def ce_loss(logits, labels):
+    l = logits._data if isinstance(logits, Tensor) else logits
+    y = labels._data if isinstance(labels, Tensor) else labels
+    logz = jax.nn.logsumexp(l, axis=-1)
+    gold = jnp.take_along_axis(l, y[..., None], axis=-1)[..., 0]
+    return Tensor._wrap(jnp.mean(logz - gold))
+
+
+def make_descs():
+    return [
+        LayerDesc(EmbedPipe),
+        *[LayerDesc(Block) for _ in range(4)],
+        LayerDesc(HeadPipe),
+    ]
+
+
+def copy_params(src, dst):
+    s = dict(src.named_parameters())
+    for n, p in dst.named_parameters():
+        p._data = s[n]._data
+
+
+def data(rng, batch=8):
+    x = jnp.asarray(rng.integers(0, VOCAB, (batch, SEQ)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, VOCAB, (batch, SEQ)), jnp.int32)
+    return x, y
+
+
+@pytest.fixture
+def fleet_pp4():
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 4, "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+class TestPipelineLayerAuthoring:
+    def test_segmentation(self):
+        model = PipelineLayer(layers=make_descs(), num_stages=4,
+                              loss_fn=ce_loss)
+        assert len(model.pre_layers) == 1
+        assert len(model.body_layers) == 4
+        assert len(model.post_layers) == 1
+        assert model.layers_per_stage == 1
+        assert "body[1:5]" in model.segment_describe()
+
+    def test_indivisible_body_raises(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            PipelineLayer(
+                layers=[LayerDesc(EmbedPipe), LayerDesc(Block),
+                        LayerDesc(Block), LayerDesc(Block),
+                        LayerDesc(HeadPipe)],
+                num_stages=2,
+            )
+
+    def test_sequential_forward_matches_manual(self, rng):
+        model = PipelineLayer(layers=make_descs(), num_stages=1)
+        x, _ = data(rng)
+        out = model(paddle.to_tensor(x))
+        h = paddle.to_tensor(x)
+        for l in model.run_function:
+            h = l(h)
+        np.testing.assert_allclose(
+            np.asarray(out._data), np.asarray(h._data), rtol=1e-6
+        )
+
+
+class TestPipelineTwin:
+    def test_pp4_matches_sequential_training(self, rng, fleet_pp4):
+        """The compiled GPipe schedule trains identically to the sequential
+        twin (reference: hybrid_parallel_pp_layer.py, loss equality ~1e-5)."""
+        pipe_model = PipelineLayer(layers=make_descs(), num_stages=4,
+                                   loss_fn=ce_loss)
+        twin = PipelineLayer(layers=make_descs(), num_stages=1,
+                             loss_fn=ce_loss)
+        copy_params(pipe_model, twin)
+
+        engine = fleet.distributed_model(pipe_model)
+        assert isinstance(engine, PipelineParallel)
+        opt = optimizer.AdamW(learning_rate=1e-2, parameters=pipe_model.parameters())
+        opt = fleet.distributed_optimizer(opt)
+
+        # twin: plain jitted step on identical data
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        tp = param_arrays(twin)
+        topt = optimizer.AdamW(learning_rate=1e-2)
+        tstate = topt.init_state_tree(tp)
+
+        @jax.jit
+        def twin_step(params, st, x, y, step_i):
+            def loss_fn(p):
+                out = functional_call(twin, p, Tensor._wrap(x))
+                return ce_loss(Tensor._wrap(out), Tensor._wrap(y))._data
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            decay = {k: (not k.endswith("bias")) and params[k].ndim > 1
+                     for k in params}
+            new_p, new_s = topt.apply_gradients_tree(
+                params, grads, st, 1e-2, step_i, decay_mask_tree=decay
+            )
+            return new_p, new_s, loss
+
+        losses_pp, losses_twin = [], []
+        for i in range(3):
+            x, y = data(rng)
+            loss = engine.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], opt
+            )
+            losses_pp.append(float(jax.device_get(loss._data)))
+            tp, tstate, tl = twin_step(tp, tstate, x, y, jnp.float32(i + 1))
+            losses_twin.append(float(jax.device_get(tl)))
+
+        np.testing.assert_allclose(losses_pp, losses_twin, rtol=2e-4,
+                                   err_msg=f"{losses_pp} vs {losses_twin}")
+        assert losses_pp[-1] < losses_pp[0]
+
+        # params synced back to the model match the twin's evolved params
+        engine._sync_to_model()
+        for n, p in pipe_model.named_parameters():
+            np.testing.assert_allclose(
+                np.asarray(p._data), np.asarray(tp[n]), atol=2e-5, err_msg=n,
+            )
+
+    def test_eval_batch(self, rng, fleet_pp4):
+        pipe_model = PipelineLayer(layers=make_descs(), num_stages=4,
+                                   loss_fn=ce_loss)
+        engine = fleet.distributed_model(pipe_model)
+        x, y = data(rng)
+        loss = engine.eval_batch([paddle.to_tensor(x), paddle.to_tensor(y)])
+        seq = ce_loss(pipe_model(paddle.to_tensor(x)), paddle.to_tensor(y))
+        np.testing.assert_allclose(
+            float(jax.device_get(loss._data)),
+            float(jax.device_get(seq._data)), rtol=1e-5,
+        )
+
+
+class TestSharedEmbedding:
+    def test_tied_head_twin(self, rng, fleet_pp4):
+        """SharedLayerDesc ties input/output embeddings; grads through both
+        uses accumulate into one weight (reference:
+        hybrid_parallel_shared_weight.py)."""
+
+        def head_fwd(master, x):
+            xd = x._data if isinstance(x, Tensor) else x
+            w = master.word.weight._data
+            return Tensor._wrap(xd @ w.T)
+
+        def descs():
+            return [
+                SharedLayerDesc("emb", EmbedPipe, shared_weight_attr="word"),
+                *[LayerDesc(Block) for _ in range(4)],
+                SharedLayerDesc("emb", EmbedPipe, forward_func=head_fwd,
+                                shared_weight_attr="word"),
+            ]
+
+        pipe_model = PipelineLayer(layers=descs(), num_stages=4,
+                                   loss_fn=ce_loss)
+        # only ONE embedding parameter set exists
+        names = [n for n, _ in pipe_model.named_parameters()
+                 if "word.weight" in n]
+        assert len(names) == 1, names
+
+        twin = PipelineLayer(layers=descs(), num_stages=1, loss_fn=ce_loss)
+        copy_params(pipe_model, twin)
+        engine = fleet.distributed_model(pipe_model)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=pipe_model.parameters())
+        opt = fleet.distributed_optimizer(opt)
+
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        tp = param_arrays(twin)
+
+        @jax.jit
+        def twin_lossgrad(params, x, y):
+            def loss_fn(p):
+                out = functional_call(twin, p, Tensor._wrap(x))
+                return ce_loss(Tensor._wrap(out), Tensor._wrap(y))._data
+
+            return jax.value_and_grad(loss_fn)(params)
+
+        x, y = data(rng)
+        loss = engine.train_batch(
+            [paddle.to_tensor(x), paddle.to_tensor(y)], opt
+        )
+        tl, tg = twin_lossgrad(tp, x, y)
+        np.testing.assert_allclose(
+            float(jax.device_get(loss._data)), float(jax.device_get(tl)),
+            rtol=1e-5,
+        )
+        # tied weight updated by BOTH embedding and head gradients
+        emb_name = names[0]
+        updated = dict(pipe_model.named_parameters())[emb_name]._data
+        expect = tp[emb_name] - 0.1 * tg[emb_name]
+        np.testing.assert_allclose(
+            np.asarray(updated), np.asarray(expect), atol=1e-5,
+        )
+
+
+class MPBlock(nn.Layer):
+    """Transformer-MLP block built from Megatron TP layers — exercises
+    mp (GSPMD, auto axes) INSIDE the pp shard_map body."""
+
+    def __init__(self):
+        super().__init__()
+        from paddle_tpu.distributed.fleet.meta_parallel import (
+            ColumnParallelLinear,
+            RowParallelLinear,
+        )
+
+        self.ln = nn.LayerNorm(H)
+        self.fc1 = ColumnParallelLinear(H, 4 * H, gather_output=False)
+        self.fc2 = RowParallelLinear(4 * H, H, input_is_parallel=True)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+
+class TestHybridPPxMP:
+    def test_pp2_mp2_dp2_twin(self, rng):
+        """Full hybrid: dp2 × pp2 × mp2 over 8 virtual devices; the compiled
+        pipeline with TP blocks matches the sequential twin (reference:
+        hybrid config 4 composition, fleet 3-D topologies)."""
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "pp_degree": 2,
+                                   "mp_degree": 2}
+        strategy.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        def descs():
+            return [
+                LayerDesc(EmbedPipe),
+                *[LayerDesc(MPBlock) for _ in range(4)],
+                LayerDesc(HeadPipe),
+            ]
+
+        pipe_model = PipelineLayer(layers=descs(), num_stages=2,
+                                   loss_fn=ce_loss)
+        twin = PipelineLayer(layers=descs(), num_stages=1, loss_fn=ce_loss)
+        copy_params(pipe_model, twin)
+        engine = fleet.distributed_model(pipe_model)
+        opt = optimizer.AdamW(learning_rate=1e-2,
+                              parameters=pipe_model.parameters())
+        opt = fleet.distributed_optimizer(opt)
+
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        tp = param_arrays(twin)
+        topt = optimizer.AdamW(learning_rate=1e-2)
+        tstate = topt.init_state_tree(tp)
+
+        @jax.jit
+        def twin_step(params, st, x, y, step_i):
+            def loss_fn(p):
+                out = functional_call(twin, p, Tensor._wrap(x))
+                return ce_loss(Tensor._wrap(out), Tensor._wrap(y))._data
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            decay = {k: (not k.endswith("bias")) and params[k].ndim > 1
+                     for k in params}
+            new_p, new_s = topt.apply_gradients_tree(
+                params, grads, st, 1e-2, step_i, decay_mask_tree=decay
+            )
+            return new_p, new_s, loss
+
+        for i in range(2):
+            x, y = data(rng)
+            loss = engine.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], opt
+            )
+            tp, tstate, tl = twin_step(tp, tstate, x, y, jnp.float32(i + 1))
+            np.testing.assert_allclose(
+                float(jax.device_get(loss._data)),
+                float(jax.device_get(tl)), rtol=2e-5,
+            )
+
+        # mp sharding actually applied to body weights: [pp, K, H, 4H] with
+        # fc1 columns split over mp
+        st = engine._state["b::fc1.weight"]
+        spec = st.sharding.spec
+        assert "pp" in str(spec) and "mp" in str(spec), spec

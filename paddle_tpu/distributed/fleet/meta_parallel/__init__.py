@@ -18,10 +18,17 @@ from .random import (
 from .tensor_parallel import TensorParallel, apply_dist_specs, param_shardings
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc
 from .pipeline_engine import PipelineParallel
+from .context_parallel import (
+    RingAttention,
+    ring_attention,
+    ulysses_attention,
+    zigzag_indices,
+)
 
 __all__ = [
     "MetaParallelBase",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "ring_attention", "ulysses_attention", "zigzag_indices", "RingAttention",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "parallel_cross_entropy_shardmap",
     "RNGStatesTracker", "get_rng_state_tracker", "model_parallel_random_seed",

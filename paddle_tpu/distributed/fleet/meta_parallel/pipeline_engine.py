@@ -46,16 +46,32 @@ __all__ = ["PipelineParallel"]
 
 
 def _unwrap_opt(optimizer):
-    """Peel wrapper optimizers (HybridParallelOptimizer, sharding) down to the
-    base Optimizer that owns the update rule."""
+    """Peel wrapper optimizers (HybridParallelOptimizer._inner_opt,
+    ShardedOptimizer._inner) down to the base Optimizer that owns the update
+    rule."""
     seen = set()
     opt = optimizer
     while True:
-        inner = getattr(opt, "_inner_opt", None) or getattr(opt, "_optim", None)
+        inner = (getattr(opt, "_inner_opt", None)
+                 or opt.__dict__.get("_inner"))
         if inner is None or id(inner) in seen:
             return opt
         seen.add(id(opt))
         opt = inner
+
+
+def _clip_norm_of(base_opt):
+    """clip_norm of the optimizer's grad clip, seeing through the
+    HybridParallelClipGrad wrapper fleet.distributed_optimizer installs."""
+    clip = getattr(base_opt, "_grad_clip", None)
+    if clip is None:
+        return None
+    if isinstance(clip, ClipGradByGlobalNorm):
+        return clip.clip_norm
+    inner = getattr(clip, "_clip", None)
+    if isinstance(inner, ClipGradByGlobalNorm):
+        return inner.clip_norm
+    return None
 
 
 class PipelineParallel(MetaParallelBase):
@@ -94,6 +110,16 @@ class PipelineParallel(MetaParallelBase):
                 "pipeline body layers with buffers (BatchNorm-style running "
                 "stats) are not supported in the compiled schedule"
             )
+        a, b = layers._body_range
+        for i, layer in enumerate(layers.run_function):
+            if a <= i < b:
+                continue
+            if any(buf is not None for _, buf in layer.named_buffers()):
+                raise NotImplementedError(
+                    f"pre/post pipeline layer {i} ({type(layer).__name__}) "
+                    "has buffers; buffer state is not threaded through the "
+                    "compiled schedule yet and would freeze at first trace"
+                )
         for l in layers.body_layers:
             if isinstance(l, _SharedLayerProxy) or any(
                 isinstance(s, _SharedLayerProxy) for s in l.sublayers()
@@ -337,15 +363,13 @@ class PipelineParallel(MetaParallelBase):
                 f"{M} microbatches"
             )
 
-        clip = getattr(base_opt, "_grad_clip", None)
-        clip_norm = (clip.clip_norm if isinstance(clip, ClipGradByGlobalNorm)
-                     else None)
+        clip_norm = _clip_norm_of(base_opt)
         scale_val = float(getattr(scaler, "_scale", 1.0) or 1.0) if (
             scaler is not None and getattr(scaler, "_enable", False)
         ) else 1.0
 
         key = (x_arr.shape, str(x_arr.dtype), y_arr.shape, str(y_arr.dtype),
-               M, clip_norm is not None, scale_val != 1.0)
+               M, clip_norm, scale_val != 1.0, id(base_opt))
         if key not in self._step_cache:
             loss_head = self._layers._loss_fn
 
@@ -371,7 +395,7 @@ class PipelineParallel(MetaParallelBase):
                     jnp.stack([jnp.all(jnp.isfinite(g)) for g in flat])
                 )
                 if clip_norm is not None:
-                    grads = ClipGradByGlobalNorm.apply_to_tree(
+                    grads, _ = ClipGradByGlobalNorm.apply_to_tree(
                         grads, clip_norm
                     )
                 new_p, new_s = base_opt.apply_gradients_tree(
@@ -409,6 +433,11 @@ class PipelineParallel(MetaParallelBase):
         if self._state is None:
             self._build_state()
         M = self._accumulate_steps
+        if x_arr.shape[0] % M != 0:
+            raise ValueError(
+                f"eval batch {x_arr.shape[0]} not divisible into "
+                f"{M} microbatches"
+            )
         key = (x_arr.shape, str(x_arr.dtype), compute_loss and y is not None)
         if key not in self._fwd_cache:
             loss_head = self._layers._loss_fn

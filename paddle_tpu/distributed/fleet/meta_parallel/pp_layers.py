@@ -179,6 +179,17 @@ class PipelineLayer(nn.Layer):
                    if type(l).__name__ == cls_name]
             if idx:
                 start, stop = idx[0], idx[-1] + 1
+                layers = list(self.run_function)[start:stop]
+                sig0 = _param_signature(layers[0])
+                for off, l in enumerate(layers[1:], 1):
+                    if _param_signature(l) != sig0:
+                        raise ValueError(
+                            f"seg_method={self._seg_method!r}: layer at index "
+                            f"{start + off} ({type(l).__name__}) inside the "
+                            f"[{start},{stop}) span is not structurally "
+                            f"identical to {cls_name}; the compiled schedule "
+                            "requires a homogeneous body"
+                        )
         n_body = stop - start
         if self._num_stages > 1:
             if n_body == 0 or n_body % self._num_stages != 0:

@@ -142,3 +142,18 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, cum_offsets=None
     out = decode_attention(q, cv[0], cv[1], lens + 1)
     out = out.reshape(bsz, nh * hd)
     return Tensor._wrap(out), Tensor._wrap(cv)
+
+
+def ring_flash_attention(q, k, v, causal=True, axis_name="sep", **kw):
+    """PaddleNLP-parity alias (reference ecosystem: ring_flash_attention.py)
+    over the native context-parallel ring kernel."""
+    from ....distributed.fleet.meta_parallel.context_parallel import (
+        ring_attention,
+    )
+
+    out = ring_attention(_unwrap(q), _unwrap(k), _unwrap(v),
+                         causal=causal, axis_name=axis_name, **kw)
+    return Tensor._wrap(out)
+
+
+__all__.append("ring_flash_attention")

@@ -340,3 +340,34 @@ class TestHybridPPxMP:
         st = engine._state["b::fc1.weight"]
         spec = st.sharding.spec
         assert "pp" in str(spec) and "mp" in str(spec), spec
+
+
+class TestGradClipPath:
+    def test_clip_through_fleet_wrapper(self, rng, fleet_pp4):
+        """fleet.distributed_optimizer wraps ClipGradByGlobalNorm in
+        HybridParallelClipGrad; the compiled step must still see and apply
+        the clip (regression: the clip was silently dropped)."""
+        from paddle_tpu.nn.clip import ClipGradByGlobalNorm
+        from paddle_tpu.distributed.fleet.meta_parallel.pipeline_engine import (
+            _clip_norm_of,
+            _unwrap_opt,
+        )
+
+        pipe_model = PipelineLayer(layers=make_descs(), num_stages=4,
+                                   loss_fn=ce_loss)
+        engine = fleet.distributed_model(pipe_model)
+        opt = optimizer.SGD(learning_rate=1.0,
+                            parameters=pipe_model.parameters(),
+                            grad_clip=ClipGradByGlobalNorm(1e-6))
+        opt = fleet.distributed_optimizer(opt)
+        base = _unwrap_opt(opt)
+        assert _clip_norm_of(base) == pytest.approx(1e-6)
+
+        before = {n: np.asarray(p._data).copy()
+                  for n, p in pipe_model.named_parameters()}
+        x, y = data(rng)
+        engine.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)], opt)
+        # with clip_norm=1e-6 and lr=1.0 the params must barely move
+        for n, p in pipe_model.named_parameters():
+            delta = np.abs(np.asarray(p._data) - before[n]).max()
+            assert delta < 1e-5, (n, delta)

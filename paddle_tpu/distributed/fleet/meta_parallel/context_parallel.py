@@ -32,17 +32,11 @@ from jax.sharding import PartitionSpec as P
 
 __all__ = [
     "ring_attention",
+    "ring_attention_op",
     "ulysses_attention",
     "zigzag_indices",
     "RingAttention",
 ]
-
-
-def _in_trace() -> bool:
-    try:
-        return not jax.core.trace_state_clean()
-    except AttributeError:  # pragma: no cover - jax internals moved
-        return False
 
 
 @functools.lru_cache(maxsize=64)
@@ -51,12 +45,10 @@ def _jitted(mapped):
 
 
 def _run_maybe_jit(mapped, *args):
-    """Partial-manual shard_map only lowers under jit; when called eagerly
-    (API-compat path) route through a cached jit so repeated eager calls
-    don't recompile. ``mapped`` must come from the lru-cached builders below
-    so its identity is stable across calls."""
-    if _in_trace():
-        return mapped(*args)
+    """Partial-manual shard_map only lowers under jit. Route every call
+    through a cached jit — correct both eagerly and inside an enclosing
+    trace (jit inlines as a pjit call). ``mapped`` must come from the
+    lru-cached builders below so its identity is stable across calls."""
     return _jitted(mapped)(*args)
 
 
@@ -105,34 +97,46 @@ def _block_attend(q, k, v, scale, mask):
 
 
 def _ring_body(q, k, v, q_pos, kv_pos, *, axis_name, causal, scale):
-    """Runs on each sep shard: rotate (k, v, kv_pos) around the ring,
-    accumulating the online-softmax merge."""
+    """Runs on each sep shard: attend to the local KV block, then
+    ``world−1`` × (rotate KV with ppermute; attend), accumulating the
+    online-softmax merge. Stats and accumulator are float32 regardless of
+    input dtype (flash-attention convention — bf16 recurrence over many ring
+    steps compounds rounding)."""
     world = jax.lax.axis_size(axis_name)
     perm = [(i, (i + 1) % world) for i in range(world)]
-    B, Sq, H, D = q.shape
+    in_dtype = q.dtype
+    qf = q.astype(jnp.float32)
 
-    m0 = jnp.full((B, H, Sq), -jnp.inf, q.dtype)
-    l0 = jnp.zeros((B, H, Sq), q.dtype)
-    o0 = jnp.zeros((B, H, Sq, D), q.dtype)
-
-    def step(carry, _):
-        m, l, o, k_c, v_c, kv_pos_c = carry
+    def attend(m, l, o, k_c, v_c, kv_pos_c):
         if causal:
             mask = q_pos[:, None] >= kv_pos_c[None, :]
         else:
-            mask = jnp.ones((Sq, k_c.shape[1]), bool)
-        m_new, l_new, o_new = _block_attend(q, k_c, v_c, scale, mask)
-        m, l, o = _online_merge(m, l, o, m_new, l_new, o_new)
+            mask = jnp.ones((q.shape[1], k_c.shape[1]), bool)
+        m_new, l_new, o_new = _block_attend(
+            qf, k_c.astype(jnp.float32), v_c.astype(jnp.float32), scale, mask
+        )
+        return _online_merge(m, l, o, m_new, l_new, o_new)
+
+    B, Sq, H, D = q.shape
+    m0 = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    o0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m, l, o = attend(m0, l0, o0, k, v, kv_pos)
+
+    def step(carry, _):
+        m, l, o, k_c, v_c, kv_pos_c = carry
         k_c = jax.lax.ppermute(k_c, axis_name, perm)
         v_c = jax.lax.ppermute(v_c, axis_name, perm)
         kv_pos_c = jax.lax.ppermute(kv_pos_c, axis_name, perm)
+        m, l, o = attend(m, l, o, k_c, v_c, kv_pos_c)
         return (m, l, o, k_c, v_c, kv_pos_c), None
 
-    (m, l, o, _, _, _), _ = jax.lax.scan(
-        step, (m0, l0, o0, k, v, kv_pos), None, length=world
-    )
+    if world > 1:
+        (m, l, o, _, _, _), _ = jax.lax.scan(
+            step, (m, l, o, k, v, kv_pos), None, length=world - 1
+        )
     l = jnp.where(l == 0.0, 1.0, l)
-    out = o / l[..., None]  # [B,H,Sq,D]
+    out = (o / l[..., None]).astype(in_dtype)  # [B,H,Sq,D]
     return jnp.transpose(out, (0, 2, 1, 3))  # [B,Sq,H,D]
 
 
@@ -245,6 +249,16 @@ def _ulysses_mapped(mesh, axis_name: str, causal: bool, scale: float,
     )
 
 
+def ring_attention_op(q, k, v, **kw):
+    """Tensor-level ring attention: records ONE tape node so eager
+    ``loss.backward()`` differentiates through the ring (repo convention:
+    framework.tensor.apply_op)."""
+    from ....framework.tensor import apply_op
+
+    return apply_op(lambda qa, ka, va: ring_attention(qa, ka, va, **kw),
+                    q, k, v)
+
+
 class RingAttention:
     """Thin layer-style wrapper for :func:`ring_attention` (keeps the
     incubate fused-layer calling convention)."""
@@ -254,11 +268,6 @@ class RingAttention:
         self.causal = causal
 
     def __call__(self, q, k, v, **kw):
-        from ....framework.tensor import Tensor
-
-        unwrap = lambda t: t._data if isinstance(t, Tensor) else t
-        out = ring_attention(
-            unwrap(q), unwrap(k), unwrap(v),
-            axis_name=self.axis_name, causal=self.causal, **kw,
+        return ring_attention_op(
+            q, k, v, axis_name=self.axis_name, causal=self.causal, **kw
         )
-        return Tensor._wrap(out)

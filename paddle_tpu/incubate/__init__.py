@@ -2,5 +2,6 @@
 experimental fused layers + distributed models (MoE lands with the EP
 milestone)."""
 from . import nn  # noqa: F401
+from . import distributed  # noqa: F401
 
 __all__ = ["nn"]

@@ -1,0 +1,14 @@
+"""Expert-parallel MoE (reference: python/paddle/incubate/distributed/models/
+moe/)."""
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .moe_layer import (
+    MoELayer,
+    count_by_gate,
+    gshard_dispatch,
+    limit_by_capacity,
+)
+
+__all__ = [
+    "MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
+    "count_by_gate", "limit_by_capacity", "gshard_dispatch",
+]

@@ -1,0 +1,135 @@
+"""MoE gates (reference: python/paddle/incubate/distributed/models/moe/gate/
+— naive_gate.py, gshard_gate.py, switch_gate.py).
+
+Each gate maps token activations [T, d_model] to routing decisions. The
+GShard/Switch gates carry a load-balancing auxiliary loss retrievable via
+``get_loss()`` (reference semantics: ``gate.loss`` accumulated per forward).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...... import nn
+from ......framework.tensor import Tensor
+
+__all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+class BaseGate(nn.Layer):
+    def __init__(self, num_expert: int, world_size: int = 1):
+        super().__init__()
+        self.world_size = world_size
+        self.num_expert = num_expert
+        self.tot_expert = world_size * num_expert
+        self._loss = None
+
+    def set_loss(self, loss):
+        self._loss = loss
+
+    def get_loss(self, clear: bool = True):
+        loss = self._loss
+        if clear:
+            self._loss = None
+        return loss
+
+    @property
+    def has_loss(self) -> bool:
+        return self._loss is not None
+
+
+class NaiveGate(BaseGate):
+    """Linear gate, top-k routing, no auxiliary loss (reference:
+    gate/naive_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__(num_expert, world_size)
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = topk
+
+    def forward(self, inp, return_all_scores: bool = False):
+        gate_logits = self.gate(inp)
+        g = _unwrap(gate_logits)
+        val, idx = jax.lax.top_k(g, self.top_k)
+        if return_all_scores:
+            return (Tensor._wrap(val), Tensor._wrap(idx), gate_logits)
+        return Tensor._wrap(val), Tensor._wrap(idx)
+
+
+def _load_balance_loss(gates, mask_first):
+    """GShard aux loss: E * mean(fraction_tokens_e · mean_prob_e)."""
+    E = gates.shape[-1]
+    density = jnp.mean(mask_first, axis=0)        # fraction routed (top-1)
+    density_proxy = jnp.mean(gates, axis=0)       # mean gate prob
+    return jnp.sum(density * density_proxy) * (E * E) / E
+
+
+class GShardGate(BaseGate):
+    """Top-2 gate with load-balance aux loss and optional capacity
+    (reference: gate/gshard_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), random_routing: bool = True,
+                 group=None):
+        super().__init__(num_expert, world_size)
+        if topk != 2:
+            raise ValueError("GShardGate reference implementation uses topk=2")
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = 2
+        self.capacity = capacity
+        self.random_routing = random_routing
+
+    def forward(self, inp):
+        logits = _unwrap(self.gate(inp))
+        gates = jax.nn.softmax(logits, axis=-1)
+        val, idx = jax.lax.top_k(gates, 2)
+        mask1 = jax.nn.one_hot(idx[..., 0], self.tot_expert)
+        self.set_loss(Tensor._wrap(_load_balance_loss(gates, mask1)))
+        if self.random_routing and self.training:
+            # reference _random_routing (moe/utils.py): drop the 2nd expert
+            # when its gate prob is small relative to a uniform draw —
+            # one_hot(-1) dispatches nothing downstream
+            from ......framework import random as _random
+
+            r = jax.random.uniform(_random.op_key(), (idx.shape[0],),
+                                   val.dtype)
+            second = jnp.where(2.0 * val[..., 1] < r, -1, idx[..., 1])
+            idx = jnp.stack([idx[..., 0], second], axis=-1)
+        return Tensor._wrap(val), Tensor._wrap(idx)
+
+
+class SwitchGate(BaseGate):
+    """Top-1 gate (Switch Transformer) with aux loss (reference:
+    gate/switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, switch_eps: float = 0.1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(num_expert, world_size)
+        if topk != 1:
+            raise ValueError("SwitchGate routes top-1")
+        self.gate = nn.Linear(d_model, self.tot_expert)
+        self.top_k = 1
+        self.switch_eps = switch_eps
+        self.capacity = capacity
+
+    def forward(self, inp):
+        logits = _unwrap(self.gate(inp))
+        if self.training and self.switch_eps > 0:
+            from ......framework import random as _random
+
+            noise = jax.random.uniform(
+                _random.op_key(), logits.shape, logits.dtype,
+                1.0 - self.switch_eps, 1.0 + self.switch_eps,
+            )
+            logits = logits * noise
+        gates = jax.nn.softmax(logits, axis=-1)
+        val, idx = jax.lax.top_k(gates, 1)
+        mask1 = jax.nn.one_hot(idx[..., 0], self.tot_expert)
+        self.set_loss(Tensor._wrap(_load_balance_loss(gates, mask1)))
+        return Tensor._wrap(val), Tensor._wrap(idx)

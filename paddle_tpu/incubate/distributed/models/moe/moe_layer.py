@@ -1,0 +1,227 @@
+"""MoE layer with expert parallelism (reference: python/paddle/incubate/
+distributed/models/moe/moe_layer.py — MoELayer; utils.py — count_by_gate,
+limit_by_capacity; and the static ops global_scatter/global_gather in
+paddle/fluid/operators/collective/).
+
+TPU-native design (SURVEY.md B16/C12): the reference routes tokens with an
+explicit all-to-all keyed by per-expert counts (``global_scatter``). The
+GSPMD formulation replaces count bookkeeping with the GShard
+dispatch/combine einsum over a *capacity* dimension:
+
+    dispatch [T, E, C]  one-hot: token t → slot c of expert e
+    expert_in = einsum('tec,th->ech', dispatch, x)      # the all-to-all
+    expert_out[e] = expert_e(expert_in[e])              # vmapped experts
+    y = einsum('tec,ech->th', combine, expert_out)      # the return a2a
+
+Expert weights are stacked ``[E, …]`` and sharded over the expert-parallel
+mesh axis; annotating ``expert_in`` as ``P('ep', …)``-sharded makes XLA
+insert exactly the all-to-all the reference hand-codes. Tokens that overflow
+an expert's capacity are dropped (zero contribution) — identical semantics
+to the reference's ``limit_by_capacity``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..... import nn
+from .....framework.tensor import Tensor, apply_op, pause_tape
+from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+
+__all__ = ["MoELayer", "gshard_dispatch", "count_by_gate", "limit_by_capacity"]
+
+
+def _unwrap(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def count_by_gate(topk_idx, num_expert: int):
+    """Tokens per expert (reference: utils.count_by_gate)."""
+    idx = _unwrap(topk_idx)
+    one = jax.nn.one_hot(idx.reshape(-1), num_expert, dtype=jnp.int32)
+    return jnp.sum(one, axis=0)
+
+
+def limit_by_capacity(topk_idx, num_expert: int, capacity: int):
+    """Mask assignments beyond each expert's capacity, preserving order
+    (reference: utils.limit_by_capacity). Returns (masked_idx, position)
+    where masked slots hold -1."""
+    idx = _unwrap(topk_idx).reshape(-1)
+    one = jax.nn.one_hot(idx, num_expert, dtype=jnp.int32)
+    pos_in_expert = jnp.cumsum(one, axis=0) * one  # 1-based rank per expert
+    pos = jnp.sum(pos_in_expert, axis=-1) - 1      # 0-based position
+    keep = pos < capacity
+    return jnp.where(keep, idx, -1).reshape(_unwrap(topk_idx).shape), pos.reshape(
+        _unwrap(topk_idx).shape
+    )
+
+
+def gshard_dispatch(gate_val, gate_idx, num_expert: int, capacity: int):
+    """Build dispatch one-hot [T, E, C] and combine weights [T, E, C] from
+    top-k gate outputs ([T, k] each). Overflow tokens are dropped."""
+    val = _unwrap(gate_val)
+    idx = _unwrap(gate_idx)
+    T, k = idx.shape
+    dispatch = jnp.zeros((T, num_expert, capacity), val.dtype)
+    combine = jnp.zeros((T, num_expert, capacity), val.dtype)
+    # positions computed per k-choice in priority order (choice 0 first),
+    # matching the reference's sequential count_by_gate over topk columns
+    running = jnp.zeros((num_expert,), jnp.int32)
+    for j in range(k):
+        e = idx[:, j]
+        one = jax.nn.one_hot(e, num_expert, dtype=jnp.int32)  # [T, E]
+        pos = running[None, :] + jnp.cumsum(one, axis=0) - 1  # [T, E]
+        slot = jnp.sum(pos * one, axis=-1)                    # [T]
+        keep = slot < capacity
+        slot_c = jnp.clip(slot, 0, capacity - 1)
+        oh = (jax.nn.one_hot(e, num_expert, dtype=val.dtype)[..., None]
+              * jax.nn.one_hot(slot_c, capacity, dtype=val.dtype)[:, None, :])
+        oh = jnp.where(keep[:, None, None], oh, 0.0)
+        dispatch = dispatch + oh
+        combine = combine + oh * val[:, j][:, None, None]
+        running = running + jnp.sum(one, axis=0)
+    return dispatch, combine
+
+
+class MoELayer(nn.Layer):
+    """Expert-parallel mixture-of-experts layer (reference: MoELayer in
+    moe_layer.py; call signature kept: experts list + gate config/dict).
+
+    ``experts``: list of structurally-identical nn.Layers (the global expert
+    set — the reference holds ``num_expert`` local experts per rank; here the
+    stacked global set is sharded over ``axis_name`` when a mesh is active).
+    """
+
+    def __init__(self, d_model: int, experts: Sequence[nn.Layer],
+                 gate=None, moe_group=None, mp_group=None,
+                 recompute_interval: int = 0, capacity_factor=None,
+                 axis_name: str = "dp", **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = nn.LayerList(list(experts))
+        self.num_expert = len(self.experts)
+        # None → use the gate's (train, eval) capacity factors
+        self.capacity_factor = (None if capacity_factor is None
+                                else float(capacity_factor))
+        self.axis_name = axis_name
+        if gate is None:
+            gate = GShardGate(d_model, self.num_expert)
+        elif isinstance(gate, dict):
+            gtype = gate.get("type", "gshard")
+            topk = gate.get("top_k", 2)
+            cls = {"gshard": GShardGate, "switch": SwitchGate,
+                   "naive": NaiveGate}[gtype]
+            gate = cls(d_model, self.num_expert, topk=topk)
+        if not isinstance(gate, BaseGate):
+            raise TypeError(f"gate must be a BaseGate, got {type(gate)}")
+        self.gate = gate
+        # structural identity check for stacking
+        sig = [tuple((n, tuple(p.shape)) for n, p in e.named_parameters())
+               for e in self.experts]
+        if any(s != sig[0] for s in sig):
+            raise ValueError("MoELayer experts must be structurally identical")
+
+    # ------------------------------------------------------------------
+    def _stacked_expert_params(self):
+        leaves = [n for n, _ in self.experts[0].named_parameters()]
+        per = [dict(e.named_parameters()) for e in self.experts]
+        return {
+            leaf: jnp.stack([_unwrap(p[leaf]) for p in per]) for leaf in leaves
+        }
+
+    def _expert_sharding(self):
+        """NamedSharding for [E, C, H] expert tensors when a hybrid mesh with
+        the expert axis is active (GSPMD inserts the a2a), else None."""
+        try:
+            from .....distributed.parallel import get_mesh
+
+            mesh = get_mesh()
+        except Exception:
+            return None
+        if (mesh is None or self.axis_name not in mesh.axis_names
+                or mesh.shape[self.axis_name] == 1
+                or self.num_expert % mesh.shape[self.axis_name]):
+            return None
+        return jax.sharding.NamedSharding(mesh, P(self.axis_name, None, None))
+
+    def _capacity(self, T: int) -> int:
+        factor = self.capacity_factor
+        if factor is None:
+            cap = getattr(self.gate, "capacity", (1.2, 2.4))
+            factor = cap[0] if self.training else cap[1]
+        return max(1, int(float(factor) * self.gate.top_k * T
+                          / self.num_expert))
+
+    def _pure_forward(self, x):
+        """Routing + expert compute on raw arrays (params read through the
+        layer tree — tracers when swapped by functional_call/apply_op).
+        Returns (y, aux_loss_or_None)."""
+        orig_shape = x.shape
+        H = orig_shape[-1]
+        xt = x.reshape(-1, H)  # [T, H]
+        T = xt.shape[0]
+
+        gate_out = self.gate(Tensor._wrap(xt))
+        val, idx = gate_out[0], gate_out[1]
+        capacity = self._capacity(T)
+
+        dispatch, combine = gshard_dispatch(val, idx, self.num_expert,
+                                            capacity)
+        expert_in = jnp.einsum("tec,th->ech", dispatch, xt)
+
+        sharding = self._expert_sharding()
+        if sharding is not None:
+            expert_in = jax.lax.with_sharding_constraint(expert_in, sharding)
+
+        template = self.experts[0]
+        stacked = self._stacked_expert_params()
+
+        from .....jit import functional_call
+
+        def apply_one(leaf_params, tokens):
+            with pause_tape():
+                return functional_call(template, leaf_params,
+                                       Tensor._wrap(tokens))
+
+        expert_out = jax.vmap(apply_one)(stacked, expert_in)  # [E, C, H]
+        if sharding is not None:
+            expert_out = jax.lax.with_sharding_constraint(expert_out, sharding)
+        y = jnp.einsum("tec,ech->th", combine, expert_out)
+        aux = self.gate.get_loss()
+        return y.reshape(orig_shape), (
+            aux._data if isinstance(aux, Tensor) else aux
+        )
+
+    def forward(self, inp):
+        """Eager-autograd-correct forward: the whole routed computation is one
+        tape node (apply_op) whose primals are the input plus every gate and
+        expert parameter, so ``loss.backward()`` reaches them (repo
+        convention; see incubate/nn/layer/fused_transformer.py)."""
+        named = list(self.named_parameters())
+        has_aux = not isinstance(self.gate, NaiveGate)
+
+        def fn(x, *arrs):
+            saved = [p._data for _, p in named]
+            try:
+                for (_, p), a in zip(named, arrs):
+                    p._data = a
+                with pause_tape():
+                    y, aux = self._pure_forward(x)
+                if has_aux:
+                    return y, (aux if aux is not None
+                               else jnp.zeros((), y.dtype))
+                return y
+            finally:
+                for (_, p), d in zip(named, saved):
+                    p._data = d
+
+        out = apply_op(fn, inp, *[p for _, p in named])
+        if has_aux:
+            y, aux = out
+            self.gate.set_loss(aux)
+            return y
+        return out

@@ -146,14 +146,14 @@ def masked_multihead_attention(x, cache_kv=None, src_mask=None, cum_offsets=None
 
 def ring_flash_attention(q, k, v, causal=True, axis_name="sep", **kw):
     """PaddleNLP-parity alias (reference ecosystem: ring_flash_attention.py)
-    over the native context-parallel ring kernel."""
+    over the native context-parallel ring kernel. Records a tape node so the
+    eager/dygraph backward reaches q/k/v."""
     from ....distributed.fleet.meta_parallel.context_parallel import (
-        ring_attention,
+        ring_attention_op,
     )
 
-    out = ring_attention(_unwrap(q), _unwrap(k), _unwrap(v),
-                         causal=causal, axis_name=axis_name, **kw)
-    return Tensor._wrap(out)
+    return ring_attention_op(q, k, v, causal=causal, axis_name=axis_name,
+                             **kw)
 
 
 __all__.append("ring_flash_attention")

@@ -66,6 +66,7 @@ _LAZY = {
     "parallel": "paddle_tpu.parallel",
     "utils": "paddle_tpu.utils",
     "device": "paddle_tpu.device_ns",
+    "inference": "paddle_tpu.inference",
 }
 
 
@@ -74,6 +75,16 @@ def __getattr__(name):
         mod = _importlib.import_module(_LAZY[name])
         globals()[name] = mod
         return mod
+    if name == "Model":  # paddle.Model — hapi's high-level trainer
+        from .hapi import Model
+
+        globals()["Model"] = Model
+        return Model
+    if name == "DataParallel":  # paddle.DataParallel
+        from .distributed.parallel import DataParallel
+
+        globals()["DataParallel"] = DataParallel
+        return DataParallel
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
 
 

@@ -49,3 +49,5 @@ __all__ = [
     "save_group_sharded_model", "build_mesh", "Group",
     "CommunicateTopology", "HybridCommunicateGroup", "HYBRID_AXES",
 ]
+from .spawn import spawn  # noqa: F401
+from . import launch  # noqa: F401

@@ -23,3 +23,5 @@ from . import meta_parallel  # noqa: F401
 from . import meta_optimizers  # noqa: F401
 from .meta_optimizers import HybridParallelOptimizer  # noqa: F401
 from .utils import log_util  # noqa: F401
+from . import recompute as recompute_mod  # noqa: F401
+from .recompute import recompute, recompute_sequential  # noqa: F401

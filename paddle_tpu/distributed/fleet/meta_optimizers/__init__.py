@@ -3,6 +3,7 @@ meta_optimizers/ — the static-graph rewrites are subsumed by compiled SPMD;
 what survives is the dygraph hybrid optimizer glue)."""
 from .dygraph_optimizer import (  # noqa: F401
     DygraphShardingOptimizer,
+    GradientMergeOptimizer,
     HybridParallelGradScaler,
     HybridParallelOptimizer,
 )
@@ -11,4 +12,5 @@ __all__ = [
     "HybridParallelOptimizer",
     "HybridParallelGradScaler",
     "DygraphShardingOptimizer",
+    "GradientMergeOptimizer",
 ]

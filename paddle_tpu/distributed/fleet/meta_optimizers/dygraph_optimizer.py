@@ -176,3 +176,50 @@ class HybridParallelGradScaler:
 
     def __getattr__(self, item):
         return getattr(self.__dict__["_scaler"], item)
+
+
+class GradientMergeOptimizer:
+    """Gradient accumulation wrapper (reference: fleet/meta_optimizers/
+    gradient_merge_optimizer.py via strategy.gradient_merge={"k_steps": k,
+    "avg": True}; SURVEY.md C16 "keep gradient-merge as an API feature").
+
+    Dygraph semantics: the tape already accumulates ``p.grad`` across
+    backward() calls while ``clear_grad`` is withheld; this wrapper holds the
+    inner optimizer back for ``k_steps`` micro-steps, optionally averaging
+    the merged gradient, then applies one real update."""
+
+    def __init__(self, optimizer, k_steps: int = 1, avg: bool = True):
+        self._inner_opt = optimizer
+        self._k_steps = max(1, int(k_steps))
+        self._avg = bool(avg)
+        self._micro_step = 0
+
+    @property
+    def steps_accumulated(self) -> int:
+        return self._micro_step
+
+    def step(self):
+        self._micro_step += 1
+        if self._micro_step < self._k_steps:
+            return  # keep accumulating; do NOT clear grads
+        if self._avg and self._k_steps > 1:
+            inv = 1.0 / self._k_steps
+            for p in self._inner_opt._parameter_list():
+                if p.grad is not None:
+                    p.grad._data = p.grad._data * inv
+        self._inner_opt.step()
+        self._inner_opt.clear_grad()
+        self._micro_step = 0
+
+    def clear_grad(self, set_to_zero=False):
+        # mid-window clears are a no-op by design (the merge owns grad
+        # lifetime); the real clear happens after the merged step
+        if self._micro_step == 0:
+            self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)

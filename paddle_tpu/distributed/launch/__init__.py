@@ -1,0 +1,12 @@
+"""Launcher (reference: python/paddle/distributed/launch/ — main.py CLI,
+controllers/collective.py, controllers/watcher.py, job/ pod model).
+
+``python -m paddle_tpu.distributed.launch [--nproc_per_node N] train.py`` —
+TPU process model: ONE process per host owns all local chips (SURVEY.md
+L11/C2), so ``--nproc_per_node`` defaults to 1 and >1 is the CPU-testing /
+multi-host-emulation path. Env contract kept verbatim: PADDLE_TRAINER_ID,
+PADDLE_TRAINERS_NUM, PADDLE_TRAINER_ENDPOINTS, PADDLE_CURRENT_ENDPOINT,
+PADDLE_MASTER.
+"""
+from .main import launch, main  # noqa: F401
+from .controllers import ElasticSupervisor, Watcher  # noqa: F401

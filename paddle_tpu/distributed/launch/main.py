@@ -1,0 +1,136 @@
+"""Launch CLI (reference: python/paddle/distributed/launch/main.py).
+
+    python -m paddle_tpu.distributed.launch \
+        [--nnodes 1] [--node_rank 0] [--master ip:port] \
+        [--nproc_per_node 1] [--log_dir log] [--elastic N] \
+        train.py [script args...]
+
+Differences from the reference, by TPU design (SURVEY.md L11):
+* default ONE process per node (a TPU host process owns all local chips);
+  ``--devices`` is accepted for compat and sets JAX_VISIBLE_DEVICES;
+* multi-node rendezvous is ``jax.distributed.initialize`` against
+  ``--master`` (the coordination service replaces the HTTP/etcd master);
+* ``--elastic N`` enables whole-world restart-from-checkpoint, N retries.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import sys
+from typing import List, Optional, Sequence
+
+from .controllers import ElasticSupervisor, Watcher, build_env
+
+__all__ = ["launch", "main"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _parse(argv: Optional[Sequence[str]] = None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (TPU process model)",
+    )
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=int(
+        os.environ.get("PADDLE_NODE_RANK", "0")))
+    p.add_argument("--master", type=str,
+                   default=os.environ.get("PADDLE_MASTER", ""))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (1 on TPU; >1 for CPU testing)")
+    p.add_argument("--devices", "--gpus", "--xpus", type=str, default="",
+                   help="compat: visible device ids for this node")
+    p.add_argument("--log_dir", type=str, default="log")
+    p.add_argument("--elastic", type=int, default=0,
+                   help="max whole-world restarts on worker failure")
+    p.add_argument("--run_mode", type=str, default="collective")
+    p.add_argument("script", type=str)
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def launch(script: str, script_args: Sequence[str] = (),
+           nproc_per_node: int = 1, nnodes: int = 1, node_rank: int = 0,
+           master: str = "", log_dir: Optional[str] = "log",
+           elastic: int = 0, devices: str = "") -> int:
+    """Programmatic entry (what main() calls; usable from tests)."""
+    world_size = nnodes * nproc_per_node
+    if world_size == 1 and not master:
+        # degenerate single-process: exec in-process environment, run script
+        env = build_env(0, 1, [f"127.0.0.1:{_free_port()}"])
+        if devices:
+            env["JAX_VISIBLE_DEVICES"] = devices
+        import subprocess
+
+        return subprocess.call([sys.executable, script, *script_args],
+                               env=env)
+
+    if nnodes > 1 and not master:
+        raise ValueError("--master ip:port is required for multi-node")
+
+    # endpoints for THIS node's workers; multi-node global endpoint list is
+    # master + per-node blocks (rank = node_rank*nproc + local)
+    base_port = _free_port()
+    host = master.split(":")[0] if master else "127.0.0.1"
+    all_eps: List[str] = []
+    for n in range(nnodes):
+        for l in range(nproc_per_node):
+            all_eps.append(
+                master if (n == 0 and l == 0 and master)
+                else f"{host}:{base_port + n * nproc_per_node + l}"
+            )
+
+    def cmd(rank_local: int) -> List[str]:
+        return [sys.executable, script, *script_args]
+
+    def builder(local_rank: int):
+        return cmd(local_rank)
+
+    first_rank = node_rank * nproc_per_node
+
+    class _NodeSupervisor(ElasticSupervisor):
+        def _spawn_world(self):
+            import subprocess
+
+            procs = []
+            for local in range(nproc_per_node):
+                rank = first_rank + local
+                env = build_env(rank, world_size, all_eps)
+                if devices:
+                    env["JAX_VISIBLE_DEVICES"] = devices
+                stdout = stderr = None
+                if self.log_dir:
+                    os.makedirs(self.log_dir, exist_ok=True)
+                    f = open(os.path.join(self.log_dir,
+                                          f"workerlog.{rank}"), "ab")
+                    stdout = stderr = f
+                procs.append(subprocess.Popen(
+                    self.cmd_builder(local), env=env,
+                    stdout=stdout, stderr=stderr,
+                ))
+            return Watcher(procs)
+
+    sup = _NodeSupervisor(builder, world_size, all_eps,
+                          max_restarts=elastic, log_dir=log_dir)
+    if elastic > 0:
+        return sup.run()
+    watcher = sup._spawn_world()
+    return watcher.wait()
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = _parse(argv)
+    return launch(
+        args.script, args.script_args, nproc_per_node=args.nproc_per_node,
+        nnodes=args.nnodes, node_rank=args.node_rank, master=args.master,
+        log_dir=args.log_dir, elastic=args.elastic, devices=args.devices,
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

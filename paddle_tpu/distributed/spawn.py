@@ -1,0 +1,66 @@
+"""paddle.distributed.spawn parity (reference:
+python/paddle/distributed/spawn.py — mp.spawn-style launcher).
+
+Starts ``nprocs`` worker processes running ``func(*args)`` with the launch
+env contract set, joins them, and re-raises the first failure. TPU note:
+one process per host is the production model; spawn targets CPU testing and
+single-host multi-process emulation.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Optional, Sequence
+
+__all__ = ["spawn"]
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _worker(func, args, rank, world_size, endpoints):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world_size),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+        "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+        "PADDLE_MASTER": endpoints[0],
+    })
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """Reference signature kept; returns the context (with ``.processes``)
+    when ``join=False``."""
+    if nprocs <= 0:
+        nprocs = 1
+    port = _free_port()
+    endpoints = [f"127.0.0.1:{port + i}" for i in range(nprocs)]
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_worker,
+                        args=(func, tuple(args), rank, nprocs, endpoints),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    class _Context:
+        processes = procs
+
+        def join(self):
+            for p in procs:
+                p.join()
+            bad = [p.exitcode for p in procs if p.exitcode]
+            if bad:
+                raise RuntimeError(f"spawned process failed: exit {bad[0]}")
+
+    c = _Context()
+    if join:
+        c.join()
+    return c

@@ -1,0 +1,243 @@
+"""paddle.Model — the Keras-like high-level trainer (reference:
+python/paddle/hapi/model.py — Model.prepare/fit/evaluate/predict/save/load).
+
+The reference dispatches to DynamicGraphAdapter (eager per-batch
+train_batch) or StaticGraphAdapter; here the eager tape path is the
+implementation and jit acceleration comes from the layer stack itself.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .callbacks import Callback, CallbackList, ProgBarLogger
+
+__all__ = ["Model"]
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor._wrap(jnp.asarray(np.asarray(x)))
+
+
+def _as_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _as_list(metrics)
+
+    # -------------------------------------------------------------- batches
+    def train_batch(self, inputs, labels=None, update: bool = True):
+        self.network.train()
+        ins = [_to_tensor(i) for i in _as_list(inputs)]
+        outs = self.network(*ins)
+        losses = self._compute_loss(outs, labels)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        total.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(np.asarray(jax.device_get(l._data)))
+                for l in losses]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        ins = [_to_tensor(i) for i in _as_list(inputs)]
+        outs = self.network(*ins)
+        losses = self._compute_loss(outs, labels)
+        self._update_metrics(outs, labels)
+        return [float(np.asarray(jax.device_get(l._data)))
+                for l in losses]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        ins = [_to_tensor(i) for i in _as_list(inputs)]
+        outs = self.network(*ins)
+        return [np.asarray(jax.device_get(o._data))
+                for o in _as_list(outs)]
+
+    def _compute_loss(self, outs, labels):
+        if self._loss is None:
+            return [o.mean() for o in _as_list(outs)]
+        labels = [_to_tensor(l) for l in _as_list(labels)]
+        out_list = _as_list(outs)
+        return [self._loss(*out_list, *labels)]
+
+    def _update_metrics(self, outs, labels):
+        if not self._metrics:
+            return
+        labels_t = [_to_tensor(l) for l in _as_list(labels)]
+        for m in self._metrics:
+            try:
+                corr = m.compute(*_as_list(outs), *labels_t)
+                m.update(np.asarray(jax.device_get(
+                    corr._data if isinstance(corr, Tensor) else corr)))
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir=None, save_freq: int = 1, verbose: int = 2,
+            drop_last: bool = False, shuffle: bool = True, num_workers: int = 0,
+            callbacks: Optional[List[Callback]] = None):
+        loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
+                                 num_workers)
+        eval_loader = (self._as_loader(eval_data, batch_size, False, False,
+                                       num_workers)
+                       if eval_data is not None else None)
+        cbs = CallbackList((callbacks or [])
+                           + ([ProgBarLogger(log_freq, verbose)]
+                              if verbose else []))
+        cbs.set_model(self)
+        cbs.set_params({"epochs": epochs, "verbose": verbose})
+        self.stop_training = False
+
+        cbs.on_train_begin()
+        history = {"loss": []}
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            epoch_losses = []
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                ins, labels = self._split_batch(batch)
+                losses = self.train_batch(ins, labels)
+                epoch_losses.append(losses[0])
+                cbs.on_train_batch_end(step, {"loss": losses[0]})
+                if self.stop_training:
+                    break
+            logs = {"loss": float(np.mean(epoch_losses))
+                    if epoch_losses else 0.0}
+            history["loss"].append(logs["loss"])
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, callbacks=cbs,
+                                          _in_fit=True)
+                logs.update(eval_logs)
+            cbs.on_epoch_end(epoch, logs)
+            if save_dir and (epoch % save_freq == 0):
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training:
+                break
+        cbs.on_train_end()
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 0, num_workers: int = 0, callbacks=None,
+                 _in_fit: bool = False):
+        loader = self._as_loader(eval_data, batch_size, False, False,
+                                 num_workers)
+        cbs = callbacks if isinstance(callbacks, CallbackList) else (
+            CallbackList(_as_list(callbacks)))
+        if not _in_fit:
+            cbs.set_model(self)
+        for m in self._metrics:
+            m.reset()
+        cbs.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbs.on_eval_batch_begin(step)
+            ins, labels = self._split_batch(batch)
+            losses.append(self.eval_batch(ins, labels)[0])
+            cbs.on_eval_batch_end(step)
+        logs = {"eval_loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            acc = m.accumulate()
+            logs[f"eval_{m.name()}" if callable(getattr(m, 'name', None))
+                 else "eval_metric"] = acc
+        cbs.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, callbacks=None):
+        loader = self._as_loader(test_data, batch_size, False, False,
+                                 num_workers)
+        outs: List[List[np.ndarray]] = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch)
+            outs.append(self.predict_batch(ins))
+        if stack_outputs and outs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # ------------------------------------------------------------------- io
+    def save(self, path: str, training: bool = True):
+        from .. import save as psave
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and hasattr(
+                self._optimizer, "state_dict"):
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        from .. import load as pload
+
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)):
+            self._optimizer.set_state_dict(pload(opt_path))
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        lines = [f"{type(self.network).__name__}:"]
+        total = 0
+        for n, p in self.network.named_parameters():
+            cnt = int(np.prod(p.shape))
+            total += cnt
+            lines.append(f"  {n}: {tuple(p.shape)} ({cnt})")
+        lines.append(f"Total params: {total}")
+        s = "\n".join(lines)
+        print(s)
+        return {"total_params": total}
+
+    # -------------------------------------------------------------- helpers
+    def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            raise ValueError("data is required")
+        if isinstance(data, DataLoader):
+            return data
+        if hasattr(data, "__iter__") and not isinstance(data, Dataset):
+            return data  # already an iterable of batches
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+
+    @staticmethod
+    def _split_batch(batch):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                return list(batch[:-1]), [batch[-1]]
+            return [batch[0]], None
+        return [batch], None

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 
 from ...... import nn
-from ......framework.tensor import Tensor
+from ......framework.tensor import Tensor, apply_op
 
 __all__ = ["BaseGate", "NaiveGate", "GShardGate", "SwitchGate"]
 
@@ -43,8 +43,9 @@ class BaseGate(nn.Layer):
 
 
 class NaiveGate(BaseGate):
-    """Linear gate, top-k routing, no auxiliary loss (reference:
-    gate/naive_gate.py)."""
+    """Linear gate, top-k routing with softmax-over-selected combine weights,
+    no auxiliary loss (reference: gate/naive_gate.py — FastMoE-style
+    ``gate_score = softmax(topk_vals)``)."""
 
     def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
                  topk: int = 2):
@@ -53,20 +54,25 @@ class NaiveGate(BaseGate):
         self.top_k = topk
 
     def forward(self, inp, return_all_scores: bool = False):
-        gate_logits = self.gate(inp)
-        g = _unwrap(gate_logits)
-        val, idx = jax.lax.top_k(g, self.top_k)
+        gate_logits = self.gate(inp)  # taped Linear keeps eager AD alive
+        k = self.top_k
+        idx = Tensor._wrap(jax.lax.top_k(_unwrap(gate_logits), k)[1])
+        # differentiable value path recorded as ONE tape node
+        val = apply_op(
+            lambda g: jax.nn.softmax(jax.lax.top_k(g, k)[0], axis=-1),
+            gate_logits,
+        )
         if return_all_scores:
-            return (Tensor._wrap(val), Tensor._wrap(idx), gate_logits)
-        return Tensor._wrap(val), Tensor._wrap(idx)
+            return (val, idx, gate_logits)
+        return val, idx
 
 
 def _load_balance_loss(gates, mask_first):
-    """GShard aux loss: E * mean(fraction_tokens_e · mean_prob_e)."""
+    """GShard/Switch aux loss: E · Σ_e density_e · density_proxy_e."""
     E = gates.shape[-1]
     density = jnp.mean(mask_first, axis=0)        # fraction routed (top-1)
     density_proxy = jnp.mean(gates, axis=0)       # mean gate prob
-    return jnp.sum(density * density_proxy) * (E * E) / E
+    return jnp.sum(density * density_proxy) * E
 
 
 class GShardGate(BaseGate):
@@ -85,22 +91,34 @@ class GShardGate(BaseGate):
         self.random_routing = random_routing
 
     def forward(self, inp):
-        logits = _unwrap(self.gate(inp))
+        logits_t = self.gate(inp)
+        logits = _unwrap(logits_t)
         gates = jax.nn.softmax(logits, axis=-1)
         val, idx = jax.lax.top_k(gates, 2)
-        mask1 = jax.nn.one_hot(idx[..., 0], self.tot_expert)
-        self.set_loss(Tensor._wrap(_load_balance_loss(gates, mask1)))
+        top1 = idx[..., 0]
+        # aux loss as a tape node of the logits → standalone backward works
+        self.set_loss(apply_op(
+            lambda g: _load_balance_loss(
+                jax.nn.softmax(g, axis=-1),
+                jax.nn.one_hot(top1, self.tot_expert)),
+            logits_t,
+        ))
+        val = apply_op(
+            lambda g: jax.lax.top_k(jax.nn.softmax(g, axis=-1), 2)[0],
+            logits_t,
+        )
         if self.random_routing and self.training:
             # reference _random_routing (moe/utils.py): drop the 2nd expert
             # when its gate prob is small relative to a uniform draw —
             # one_hot(-1) dispatches nothing downstream
             from ......framework import random as _random
 
+            val_arr = _unwrap(val)
             r = jax.random.uniform(_random.op_key(), (idx.shape[0],),
-                                   val.dtype)
-            second = jnp.where(2.0 * val[..., 1] < r, -1, idx[..., 1])
+                                   val_arr.dtype)
+            second = jnp.where(2.0 * val_arr[..., 1] < r, -1, idx[..., 1])
             idx = jnp.stack([idx[..., 0], second], axis=-1)
-        return Tensor._wrap(val), Tensor._wrap(idx)
+        return val, Tensor._wrap(idx)
 
 
 class SwitchGate(BaseGate):
@@ -119,17 +137,28 @@ class SwitchGate(BaseGate):
         self.capacity = capacity
 
     def forward(self, inp):
-        logits = _unwrap(self.gate(inp))
+        logits_t = self.gate(inp)
+        noise = None
         if self.training and self.switch_eps > 0:
             from ......framework import random as _random
 
             noise = jax.random.uniform(
-                _random.op_key(), logits.shape, logits.dtype,
+                _random.op_key(), _unwrap(logits_t).shape,
+                _unwrap(logits_t).dtype,
                 1.0 - self.switch_eps, 1.0 + self.switch_eps,
             )
-            logits = logits * noise
-        gates = jax.nn.softmax(logits, axis=-1)
-        val, idx = jax.lax.top_k(gates, 1)
-        mask1 = jax.nn.one_hot(idx[..., 0], self.tot_expert)
-        self.set_loss(Tensor._wrap(_load_balance_loss(gates, mask1)))
-        return Tensor._wrap(val), Tensor._wrap(idx)
+
+        def gated(g):
+            if noise is not None:
+                g = g * noise
+            return jax.nn.softmax(g, axis=-1)
+
+        idx = jax.lax.top_k(gated(_unwrap(logits_t)), 1)[1]
+        top1 = idx[..., 0]
+        self.set_loss(apply_op(
+            lambda g: _load_balance_loss(
+                gated(g), jax.nn.one_hot(top1, self.tot_expert)),
+            logits_t,
+        ))
+        val = apply_op(lambda g: jax.lax.top_k(gated(g), 1)[0], logits_t)
+        return val, Tensor._wrap(idx)

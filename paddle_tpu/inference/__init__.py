@@ -1,0 +1,154 @@
+"""Inference runtime (reference: paddle/fluid/inference/ —
+api/analysis_predictor.cc, api/analysis_config.cc, paddle_inference_api.h;
+Python surface paddle.inference.Config/create_predictor).
+
+TPU-native (SURVEY.md A19): the reference loads a ProgramDesc, runs an IR
+pass pipeline (fusion, TensorRT subgraph capture) and executes through
+InterpreterCore. Here the saved artifact is already a compiled-friendly
+StableHLO module (jit.save), XLA is the optimizer ("XLA replaces TRT"), and
+the Predictor is a thin zero-copy runner with the reference's handle-based
+API kept verbatim: get_input_names / get_input_handle / copy_from_cpu /
+run / get_output_handle / copy_to_cpu.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor"]
+
+
+class Config:
+    """Reference: paddle.inference.Config. Accepts the jit.save prefix
+    (``Config(prog_file, params_file)`` also accepted for signature parity —
+    the prefix is derived from ``prog_file``)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".stablehlo.bin"):
+            prog_file = prog_file[: -len(".stablehlo.bin")]
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._memory_pool_init_size_mb = 100
+        self._device = "tpu"
+        self._device_id = 0
+        self._ir_optim = True
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.__init__(prog_file, params_file)
+
+    def model_dir(self):
+        return self._prefix
+
+    def prog_file(self):
+        return self._prefix
+
+    # compat no-ops (XLA owns these concerns)
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def enable_tensorrt_engine(self, *a, **k):  # pragma: no cover
+        raise NotImplementedError(
+            "TensorRT is CUDA-only; XLA compiles the whole module on TPU "
+            "(reference: inference/tensorrt/ — subsumed)"
+        )
+
+
+class Tensor:
+    """Handle-based IO tensor (reference: paddle_infer.Tensor /
+    ZeroCopyTensor)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[jax.Array] = None
+
+    def copy_from_cpu(self, arr):
+        self._value = jnp.asarray(arr)
+
+    def copy_to_cpu(self):
+        if self._value is None:
+            raise RuntimeError(f"output {self.name!r} not populated; run()?")
+        return np.asarray(jax.device_get(self._value))
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+
+class Predictor:
+    """Reference: analysis_predictor.cc AnalysisPredictor (Python:
+    paddle_infer.Predictor). Wraps a loaded StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+
+        if not config._prefix:
+            raise ValueError("Config has no model path")
+        self._translated = jit_load(config._prefix)
+        n_in = len(self._translated._exported.in_avals) - len(
+            jax.tree_util.tree_leaves(self._translated._state)
+        )
+        self._input_names = [f"x{i}" for i in range(max(n_in, 0))] or ["x0"]
+        self._inputs: Dict[str, Tensor] = {
+            n: Tensor(n) for n in self._input_names
+        }
+        self._outputs: List[Tensor] = []
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[Sequence] = None):
+        """Handle-based (reference style) or direct: ``run([np arrays]) ->
+        [np arrays]``."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        args = []
+        for n in self._input_names:
+            h = self._inputs[n]
+            if h._value is None:
+                raise RuntimeError(f"input {n!r} not set")
+            args.append(h._value)
+        out = self._translated(*args)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        self._outputs = []
+        for i, o in enumerate(outs):
+            t = Tensor(f"out{i}")
+            t._value = o._data if hasattr(o, "_data") else jnp.asarray(o)
+            self._outputs.append(t)
+        if inputs is not None:
+            return [t.copy_to_cpu() for t in self._outputs]
+        return None
+
+    def get_output_names(self) -> List[str]:
+        return [t.name for t in self._outputs] or ["out0"]
+
+    def get_output_handle(self, name: str) -> Tensor:
+        for t in self._outputs:
+            if t.name == name:
+                return t
+        raise KeyError(name)
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Reference: paddle_infer.create_predictor."""
+    return Predictor(config)

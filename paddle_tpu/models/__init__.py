@@ -4,3 +4,9 @@ GPT is the flagship family — it is what the acceptance configs 3/4 train
 (GPT-2 TP decode, GPT-3 6.7B hybrid; see BASELINE.md).
 """
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_medium, gpt3_6p7b  # noqa: F401
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForMaskedLM,
+    BertModel,
+    BertPretrainingCriterion,
+)

@@ -1,0 +1,166 @@
+"""BERT model family (acceptance config 2: BERT-base MLM DP — SURVEY.md §6;
+reference model construction uses python/paddle/nn/layer/transformer.py
+TransformerEncoder, mirroring PaddleNLP's BertModel head structure).
+
+TPU notes: bf16-friendly (LayerNorm/softmax in fp32 via the layer lib), all
+shapes static, pooler+MLM heads as plain Layers so the whole pretraining
+step jits into one XLA program.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..framework.tensor import Tensor
+import paddle_tpu.nn.functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertPretrainingCriterion"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(config.vocab_size,
+                                            config.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        ids = input_ids._data if isinstance(input_ids, Tensor) else input_ids
+        B, S = ids.shape
+        if position_ids is None:
+            position_ids = Tensor._wrap(
+                jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S)))
+        if token_type_ids is None:
+            token_type_ids = Tensor._wrap(jnp.zeros((B, S), jnp.int32))
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, hidden):
+        h = hidden._data if isinstance(hidden, Tensor) else hidden
+        return F.tanh(self.dense(Tensor._wrap(h[:, 0])))
+
+
+class BertModel(nn.Layer):
+    """Reference shape: paddle.nn.TransformerEncoder stack + pooler."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        encoder_layer = nn.TransformerEncoderLayer(
+            d_model=config.hidden_size,
+            nhead=config.num_attention_heads,
+            dim_feedforward=config.intermediate_size,
+            dropout=config.hidden_dropout_prob,
+            activation=config.hidden_act,
+            attn_dropout=config.attention_probs_dropout_prob,
+            act_dropout=0.0,
+            normalize_before=False,
+        )
+        self.encoder = nn.TransformerEncoder(encoder_layer,
+                                             config.num_hidden_layers)
+        self.pooler = BertPooler(config)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                position_ids=None):
+        if attention_mask is not None:
+            m = (attention_mask._data if isinstance(attention_mask, Tensor)
+                 else jnp.asarray(attention_mask))
+            # [B, S] 1/0 → additive [B, 1, 1, S]
+            attention_mask = Tensor._wrap(
+                (1.0 - m[:, None, None, :].astype(jnp.float32)) * -1e4)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(x, src_mask=attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertLMPredictionHead(nn.Layer):
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size,
+                                       epsilon=config.layer_norm_eps)
+        self.activation = config.hidden_act
+        # decoder tied to input embeddings (reference: weight sharing).
+        # object.__setattr__ bypasses Layer registration so the tied weight
+        # is owned ONLY by the embedding (no duplicate state_dict entry).
+        object.__setattr__(self, "_tied", embedding_weights)
+        self.decoder_bias = self.create_parameter(
+            shape=[config.vocab_size], is_bias=True)
+
+    def forward(self, hidden):
+        x = self.layer_norm(getattr(F, self.activation)(self.transform(hidden)))
+        xd = x._data if isinstance(x, Tensor) else x
+        w = self._tied._data  # [vocab, hidden]
+        return Tensor._wrap(xd @ w.T) + self.decoder_bias
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.cls = BertLMPredictionHead(
+            config, self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.cls(seq)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    """MLM loss with ignore index −100 on unmasked positions (reference:
+    masked_lm_loss in the BERT pretraining scripts)."""
+
+    def __init__(self, vocab_size: int):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, masked_lm_labels):
+        logits = (prediction_scores._data
+                  if isinstance(prediction_scores, Tensor)
+                  else prediction_scores)
+        labels = (masked_lm_labels._data
+                  if isinstance(masked_lm_labels, Tensor)
+                  else masked_lm_labels)
+        import jax
+
+        valid = labels >= 0
+        safe = jnp.where(valid, labels, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        per_tok = jnp.where(valid, logz - gold, 0.0)
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return Tensor._wrap(jnp.sum(per_tok) / denom)

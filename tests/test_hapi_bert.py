@@ -1,0 +1,156 @@
+"""BERT family + hapi Model tests (acceptance config 2 slice + B10)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.hapi import EarlyStopping, Model, ModelCheckpoint
+from paddle_tpu.models.bert import (
+    BertConfig,
+    BertForMaskedLM,
+    BertModel,
+    BertPretrainingCriterion,
+)
+
+CFG = BertConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                 num_attention_heads=4, intermediate_size=64,
+                 max_position_embeddings=32, hidden_dropout_prob=0.0,
+                 attention_probs_dropout_prob=0.0)
+
+
+class TestBert:
+    def test_forward_shapes(self, rng):
+        model = BertModel(CFG)
+        model.eval()
+        ids = jnp.asarray(rng.integers(0, 64, (2, 16)), jnp.int32)
+        seq, pooled = model(Tensor._wrap(ids))
+        assert tuple(seq.shape) == (2, 16, 32)
+        assert tuple(pooled.shape) == (2, 32)
+
+    def test_attention_mask_blocks_padding(self, rng):
+        """Changing PADDED tokens must not change unmasked outputs."""
+        model = BertModel(CFG)
+        model.eval()
+        ids = np.asarray(rng.integers(1, 64, (1, 8)), np.int32)
+        mask = np.ones((1, 8), np.float32)
+        mask[0, 6:] = 0.0
+        ids2 = ids.copy()
+        ids2[0, 6:] = 5  # perturb padding
+        s1, _ = model(Tensor._wrap(jnp.asarray(ids)),
+                      attention_mask=Tensor._wrap(jnp.asarray(mask)))
+        s2, _ = model(Tensor._wrap(jnp.asarray(ids2)),
+                      attention_mask=Tensor._wrap(jnp.asarray(mask)))
+        np.testing.assert_allclose(np.asarray(s1._data)[:, :6],
+                                   np.asarray(s2._data)[:, :6], atol=1e-5)
+
+    def test_mlm_tied_embeddings_single_param(self):
+        model = BertForMaskedLM(CFG)
+        names = [n for n, _ in model.named_parameters()
+                 if "word_embeddings" in n]
+        assert len(names) == 1
+        # decoder has no independent weight
+        assert not any("cls" in n and "weight" in n and "transform" not in n
+                       and "layer_norm" not in n
+                       for n, _ in model.named_parameters())
+
+    def test_mlm_trains_jitted(self, rng):
+        """Config-2 slice: tiny BERT MLM step fully jitted, loss drops."""
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        model = BertForMaskedLM(CFG)
+        model.train()
+        crit = BertPretrainingCriterion(CFG.vocab_size)
+        opt = optimizer.AdamW(learning_rate=1e-3)
+        params = param_arrays(model)
+        state = opt.init_state_tree(params)
+
+        ids = jnp.asarray(rng.integers(0, 64, (4, 16)), jnp.int32)
+        labels = np.full((4, 16), -100, np.int32)
+        labels[:, :4] = np.asarray(ids)[:, :4]  # 25% masked positions
+        labels = jnp.asarray(labels)
+
+        @jax.jit
+        def step(params, state, step_i):
+            def loss_fn(p):
+                logits = functional_call(model, p, Tensor._wrap(ids))
+                return crit(Tensor._wrap(logits), Tensor._wrap(labels))._data
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            new_p, new_s = opt.apply_gradients_tree(
+                params, grads, state, 1e-3, step_i)
+            return new_p, new_s, loss
+
+        losses = []
+        for i in range(4):
+            params, state, loss = step(params, state, jnp.float32(i + 1))
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+
+class TestHapiModel:
+    def _dataset(self, rng, n=32):
+        from paddle_tpu.io import Dataset
+
+        X = rng.standard_normal((n, 8)).astype(np.float32)
+        W = rng.standard_normal((8, 1)).astype(np.float32)
+        Y = (X @ W).astype(np.float32)
+
+        class DS(Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return X[i], Y[i]
+
+        return DS()
+
+    def test_fit_evaluate_predict(self, rng, tmp_path):
+        net = nn.Linear(8, 1)
+        model = Model(net)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=net.parameters())
+
+        class MSE(nn.Layer):
+            def forward(self, pred, label):
+                return ((pred - label) ** 2).mean()
+
+        model.prepare(optimizer=opt, loss=MSE())
+        ds = self._dataset(rng)
+        hist = model.fit(ds, epochs=3, batch_size=8, verbose=0)
+        assert hist["loss"][-1] < hist["loss"][0]
+
+        logs = model.evaluate(ds, batch_size=8)
+        assert logs["eval_loss"] < hist["loss"][0]
+
+        preds = model.predict(ds, batch_size=8, stack_outputs=True)
+        assert preds[0].shape == (32, 1)
+
+    def test_checkpoint_and_early_stopping(self, rng, tmp_path):
+        net = nn.Linear(8, 1)
+        model = Model(net)
+        opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+
+        class MSE(nn.Layer):
+            def forward(self, pred, label):
+                return ((pred - label) ** 2).mean()
+
+        model.prepare(optimizer=opt, loss=MSE())
+        ds = self._dataset(rng)
+        ckpt_dir = str(tmp_path / "ck")
+        model.fit(ds, eval_data=ds, epochs=2, batch_size=8, verbose=0,
+                  callbacks=[ModelCheckpoint(save_dir=ckpt_dir),
+                             EarlyStopping("eval_loss", patience=5)])
+        import os
+
+        assert os.path.exists(os.path.join(ckpt_dir, "final.pdparams"))
+
+        # load round-trip
+        net2 = nn.Linear(8, 1)
+        m2 = Model(net2)
+        m2.prepare(optimizer=None, loss=MSE())
+        m2.load(os.path.join(ckpt_dir, "final"))
+        w1 = np.asarray(dict(net.named_parameters())["weight"]._data)
+        w2 = np.asarray(dict(net2.named_parameters())["weight"]._data)
+        np.testing.assert_allclose(w1, w2)

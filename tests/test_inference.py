@@ -1,0 +1,52 @@
+"""Inference Predictor tests (SURVEY.md A19/L10: save via jit.save, reload
+through the paddle_infer-shaped API)."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit import InputSpec, save
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def test_predictor_roundtrip(tmp_path, rng):
+    net = Net()
+    net.eval()
+    prefix = str(tmp_path / "model")
+    save(net, prefix, input_spec=[InputSpec([2, 8], "float32")])
+
+    x = rng.standard_normal((2, 8)).astype(np.float32)
+    ref = np.asarray(net(Tensor._wrap(jnp.asarray(x)))._data)
+
+    pred = create_predictor(Config(prefix))
+    # handle-based flow (reference API style)
+    names = pred.get_input_names()
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    # direct flow
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], ref, atol=1e-6)
+
+
+def test_config_prefix_normalization(tmp_path):
+    c = Config(str(tmp_path / "m") + ".stablehlo.bin")
+    assert c.prog_file() == str(tmp_path / "m")
+    c2 = Config(str(tmp_path / "m") + ".pdmodel")
+    assert c2.prog_file() == str(tmp_path / "m")

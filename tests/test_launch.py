@@ -1,0 +1,113 @@
+"""Launcher / watcher / elastic supervisor tests (SURVEY.md L11, §5.3 —
+fault injection IS buildable here: kill a worker, supervisor restarts the
+world; exceeds the reference's untested elastic path)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import launch
+from paddle_tpu.distributed.launch.controllers import (
+    ElasticSupervisor,
+    Watcher,
+    build_env,
+)
+
+
+def test_build_env_contract():
+    env = build_env(1, 4, [f"h:{p}" for p in range(4)], base_env={})
+    assert env["PADDLE_TRAINER_ID"] == "1"
+    assert env["PADDLE_TRAINERS_NUM"] == "4"
+    assert env["PADDLE_CURRENT_ENDPOINT"] == "h:1"
+    assert env["PADDLE_MASTER"] == "h:0"
+
+
+def test_launch_two_workers_env(tmp_path):
+    """2-proc CPU launch: each worker sees its rank/world in the env contract
+    (reference: test_dist_base.py spawn harness, sans NCCL)."""
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""
+        import os, pathlib
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        world = os.environ["PADDLE_TRAINERS_NUM"]
+        eps = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")
+        assert len(eps) == int(world)
+        pathlib.Path(os.environ["OUT_DIR"], f"rank{rank}").write_text(world)
+    """))
+    os.environ["OUT_DIR"] = str(tmp_path)
+    try:
+        code = launch(str(script), nproc_per_node=2, log_dir=str(tmp_path / "log"))
+    finally:
+        del os.environ["OUT_DIR"]
+    assert code == 0
+    assert (tmp_path / "rank0").read_text() == "2"
+    assert (tmp_path / "rank1").read_text() == "2"
+    # per-rank logs written (reference layout log/workerlog.N)
+    assert (tmp_path / "log" / "workerlog.0").exists()
+
+
+def test_watcher_kills_world_on_failure(tmp_path):
+    good = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+    bad = subprocess.Popen([sys.executable, "-c", "import sys; sys.exit(3)"])
+    w = Watcher([good, bad])
+    code = w.wait()
+    assert code == 3
+    assert good.poll() is not None  # sibling was torn down
+
+
+def test_elastic_restart_from_failure(tmp_path):
+    """Worker crashes on first attempt, succeeds on second (flag file):
+    supervisor restarts the whole world and exits 0."""
+    flag = tmp_path / "flag"
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, pathlib, sys
+        flag = pathlib.Path({str(flag)!r})
+        if not flag.exists():
+            flag.write_text("")
+            sys.exit(7)   # first life: crash (simulated fault injection)
+        sys.exit(0)
+    """))
+    sup = ElasticSupervisor(
+        cmd_builder=lambda rank: [sys.executable, str(script)],
+        world_size=2, endpoints=["127.0.0.1:1", "127.0.0.1:2"],
+        max_restarts=2, log_dir=str(tmp_path / "log"),
+    )
+    assert sup.run() == 0
+    assert sup.restarts == 1
+
+
+def test_elastic_gives_up(tmp_path):
+    sup = ElasticSupervisor(
+        cmd_builder=lambda rank: [sys.executable, "-c", "import sys; sys.exit(9)"],
+        world_size=1, endpoints=["127.0.0.1:1"], max_restarts=1,
+    )
+    assert sup.run() == 9
+    assert sup.restarts == 2
+
+
+def test_spawn_env_contract(tmp_path):
+    """paddle.distributed.spawn: worker fn must be importable (spawn-context
+    pickling — same constraint as the reference), so drive via a script."""
+    out = tmp_path / "o"
+    out.mkdir()
+    script = tmp_path / "driver.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, pathlib, sys
+        sys.path.insert(0, "/root/repo")
+
+        def f(base):
+            pathlib.Path(base, os.environ["PADDLE_TRAINER_ID"]).write_text(
+                os.environ["PADDLE_TRAINERS_NUM"])
+
+        if __name__ == "__main__":
+            from paddle_tpu.distributed import spawn
+            spawn(f, args=({str(out)!r},), nprocs=2)
+    """))
+    ctx = subprocess.run([sys.executable, str(script)], cwd="/root/repo",
+                         capture_output=True, text=True, timeout=120)
+    assert ctx.returncode == 0, ctx.stderr
+    assert (out / "0").read_text() == "2"
+    assert (out / "1").read_text() == "2"

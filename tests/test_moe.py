@@ -150,6 +150,10 @@ class TestMoELayerTwin:
             )
             layer.eval()
             x = jnp.asarray(rng.standard_normal((2, 8, D)), jnp.float32)
+            # the EP sharding hook actually engages on this mesh
+            sharding = layer._expert_sharding()
+            assert sharding is not None
+            assert "dp" in str(sharding.spec)
             out_mesh = layer(Tensor._wrap(x))
             ref = dense_twin(layer, x)
             np.testing.assert_allclose(np.asarray(out_mesh._data), ref,
@@ -197,3 +201,31 @@ class TestEagerBackward:
             assert float(jnp.max(jnp.abs(q.grad._data))) > 0
         finally:
             set_mesh(None)
+
+
+class TestGateStandalone:
+    def test_gate_eager_backward(self, rng):
+        """Gates used standalone keep the eager autograd chain (regression:
+        val/aux were detached from the tape)."""
+        g = GShardGate(D, E)
+        g.train()
+        x = paddle.to_tensor(
+            jnp.asarray(rng.standard_normal((6, D)), jnp.float32))
+        val, idx = g(x)
+        aux = g.get_loss()
+        (val.sum() + aux).backward()
+        w = dict(g.named_parameters())["gate.weight"]
+        assert w.grad is not None
+        assert float(jnp.max(jnp.abs(w.grad._data))) > 0
+
+    def test_naive_gate_normalized(self, rng):
+        """NaiveGate combine weights are softmax over the selected k
+        (positive, sum to 1)."""
+        g = NaiveGate(D, E, topk=2)
+        g.eval()
+        x = paddle.to_tensor(
+            jnp.asarray(rng.standard_normal((5, D)), jnp.float32))
+        val, idx = g(x)
+        v = np.asarray(val._data)
+        assert (v > 0).all()
+        np.testing.assert_allclose(v.sum(-1), 1.0, atol=1e-6)

@@ -1,0 +1,139 @@
+"""Recompute (activation checkpointing) + gradient merge tests
+(SURVEY.md C15/C16)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet import recompute, recompute_sequential
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    GradientMergeOptimizer,
+)
+from paddle_tpu.framework.tensor import Tensor
+
+D = 8
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(D, 4 * D)
+        self.fc2 = nn.Linear(4 * D, D)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + self.fc2(F.gelu(self.fc1(x)))
+
+
+class TestRecompute:
+    def test_eager_grads_match_plain(self, rng):
+        """loss.backward() through recompute == plain forward gradients
+        (reference test pattern: test_dygraph_recompute)."""
+        block = Block()
+        x = jnp.asarray(rng.standard_normal((4, D)), jnp.float32)
+
+        t1 = paddle.to_tensor(x)
+        out = recompute(block, t1)
+        (out * out).sum().backward()
+        g_rc = {n: np.asarray(p.grad._data)
+                for n, p in block.named_parameters()}
+        block.clear_gradients() if hasattr(block, "clear_gradients") else None
+        for _, p in block.named_parameters():
+            p.clear_grad() if hasattr(p, "clear_grad") else setattr(p, "grad", None)
+
+        t2 = paddle.to_tensor(x)
+        out2 = block(t2)
+        (out2 * out2).sum().backward()
+        g_plain = {n: np.asarray(p.grad._data)
+                   for n, p in block.named_parameters()}
+        for n in g_plain:
+            np.testing.assert_allclose(g_rc[n], g_plain[n], atol=1e-5,
+                                       err_msg=n)
+
+    def test_inside_jitted_step(self, rng):
+        """recompute() embeds into a functional_call + jax.grad trace."""
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.b1 = Block()
+                self.b2 = Block()
+
+            def forward(self, x):
+                x = recompute(self.b1, x)
+                x = recompute(self.b2, x)
+                return x
+
+        net = Net()
+        params = param_arrays(net)
+        x = jnp.asarray(rng.standard_normal((4, D)), jnp.float32)
+
+        @jax.jit
+        def lossgrad(p):
+            def f(p):
+                out = functional_call(net, p, Tensor._wrap(x))
+                return jnp.sum(out ** 2)
+
+            return jax.value_and_grad(f)(p)
+
+        loss, grads = lossgrad(params)
+
+        def f_plain(p):
+            out = functional_call(net, p, Tensor._wrap(x))
+            return jnp.sum(out ** 2)
+
+        loss_p, grads_p = jax.value_and_grad(f_plain)(params)
+        np.testing.assert_allclose(float(loss), float(loss_p), rtol=1e-6)
+        for n in grads:
+            np.testing.assert_allclose(np.asarray(grads[n]),
+                                       np.asarray(grads_p[n]), atol=1e-5)
+
+    def test_recompute_sequential(self, rng):
+        seq = nn.Sequential(Block(), Block(), Block(), Block())
+        x = jnp.asarray(rng.standard_normal((4, D)), jnp.float32)
+        out = recompute_sequential({"segments": 2}, seq, paddle.to_tensor(x))
+        ref = seq(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.asarray(ref._data), atol=1e-6)
+
+
+class TestGradientMerge:
+    def test_k_step_merge_equals_big_batch(self, rng):
+        """k micro-steps with merge == one step on the concatenated batch
+        (avg=True; SGD makes the equivalence exact)."""
+        net_a = nn.Linear(D, 1)
+        net_b = nn.Linear(D, 1)
+        # identical init
+        for (n, pa), (_, pb) in zip(net_a.named_parameters(),
+                                    net_b.named_parameters()):
+            pb._data = pa._data
+
+        opt_a = GradientMergeOptimizer(
+            optimizer.SGD(learning_rate=0.1, parameters=net_a.parameters()),
+            k_steps=4, avg=True,
+        )
+        opt_b = optimizer.SGD(learning_rate=0.1,
+                              parameters=net_b.parameters())
+
+        xs = jnp.asarray(rng.standard_normal((16, D)), jnp.float32)
+        ys = jnp.asarray(rng.standard_normal((16, 1)), jnp.float32)
+
+        for i in range(4):
+            xb, yb = xs[i * 4:(i + 1) * 4], ys[i * 4:(i + 1) * 4]
+            loss = ((net_a(paddle.to_tensor(xb)) - paddle.to_tensor(yb)) ** 2).sum()
+            loss.backward()
+            opt_a.step()
+
+        loss_b = ((net_b(paddle.to_tensor(xs)) - paddle.to_tensor(ys)) ** 2).sum() / 4.0
+        loss_b.backward()
+        opt_b.step()
+
+        for (n, pa), (_, pb) in zip(net_a.named_parameters(),
+                                    net_b.named_parameters()):
+            np.testing.assert_allclose(np.asarray(pa._data),
+                                       np.asarray(pb._data), atol=1e-5,
+                                       err_msg=n)

@@ -67,6 +67,10 @@ _LAZY = {
     "utils": "paddle_tpu.utils",
     "device": "paddle_tpu.device_ns",
     "inference": "paddle_tpu.inference",
+    "fft": "paddle_tpu.fft",
+    "distribution": "paddle_tpu.distribution",
+    "sparse": "paddle_tpu.sparse",
+    "signal": "paddle_tpu.signal",
 }
 
 

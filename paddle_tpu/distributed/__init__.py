@@ -51,3 +51,16 @@ __all__ = [
 ]
 from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    Partial,
+    ProcessMesh,
+    Replicate,
+    Shard,
+    dtensor_from_fn,
+    reshard,
+    shard_tensor,
+)
+from .store import TCPStore  # noqa: F401

@@ -95,10 +95,7 @@ def recompute_sequential(ctx: dict, functions, *args, **kwargs):
     fleet.recompute.recompute_sequential; ctx = {"segments": n,
     "preserve_rng_state": ...})."""
     segments = int(ctx.get("segments", 1))
-    if hasattr(functions, "_sub_layers"):
-        layers = list(functions)
-    else:
-        layers = list(functions)
+    layers = list(functions)  # Sequential and plain lists both iterate
     if not layers:
         raise ValueError("recompute_sequential: empty layer list")
     per = max(1, len(layers) // segments)

@@ -42,9 +42,18 @@ class Watcher:
     controllers/watcher.py + Controller.watch)."""
 
     def __init__(self, procs: List[subprocess.Popen],
-                 log_prefix: str = "worker"):
+                 log_prefix: str = "worker", owned_files=None):
         self.procs = procs
         self.log_prefix = log_prefix
+        self._owned_files = list(owned_files or [])
+
+    def close_files(self):
+        for f in self._owned_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        self._owned_files = []
 
     def poll(self) -> Optional[int]:
         """None while all alive; first non-zero exit code once any worker
@@ -75,14 +84,17 @@ class Watcher:
                     pass
 
     def wait(self, poll_interval: float = 0.2) -> int:
-        while True:
-            code = self.poll()
-            if code == 0:
-                return 0
-            if code is not None:
-                self.kill_all()
-                return code
-            time.sleep(poll_interval)
+        try:
+            while True:
+                code = self.poll()
+                if code == 0:
+                    return 0
+                if code is not None:
+                    self.kill_all()
+                    return code
+                time.sleep(poll_interval)
+        finally:
+            self.close_files()
 
 
 class ElasticSupervisor:
@@ -103,6 +115,7 @@ class ElasticSupervisor:
 
     def _spawn_world(self) -> Watcher:
         procs = []
+        files = []
         for rank in range(self.world_size):
             env = build_env(rank, self.world_size, self.endpoints)
             stdout = stderr = None
@@ -111,12 +124,13 @@ class ElasticSupervisor:
                 # reference layout: log/workerlog.N
                 f = open(os.path.join(self.log_dir, f"workerlog.{rank}"),
                          "ab")
+                files.append(f)
                 stdout = stderr = f
             procs.append(subprocess.Popen(
                 self.cmd_builder(rank), env=env, stdout=stdout,
                 stderr=stderr,
             ))
-        return Watcher(procs)
+        return Watcher(procs, owned_files=files)
 
     def run(self) -> int:
         while True:

@@ -73,17 +73,21 @@ def launch(script: str, script_args: Sequence[str] = (),
     if nnodes > 1 and not master:
         raise ValueError("--master ip:port is required for multi-node")
 
-    # endpoints for THIS node's workers; multi-node global endpoint list is
-    # master + per-node blocks (rank = node_rank*nproc + local)
-    base_port = _free_port()
-    host = master.split(":")[0] if master else "127.0.0.1"
-    all_eps: List[str] = []
-    for n in range(nnodes):
-        for l in range(nproc_per_node):
-            all_eps.append(
-                master if (n == 0 and l == 0 and master)
-                else f"{host}:{base_port + n * nproc_per_node + l}"
-            )
+    # The REAL multi-node contract is (coordinator address, world size,
+    # rank): jax.distributed.initialize needs nothing else, so the endpoint
+    # list is derived DETERMINISTICALLY from the master address — identical
+    # on every node (the reference gathers real per-node endpoints through
+    # its HTTP/etcd master; a KV exchange via TCPStore can upgrade this
+    # later). Single-node runs use local free ports.
+    if master:
+        host, mport = master.split(":")
+        base_port = int(mport)
+    else:
+        host, base_port = "127.0.0.1", _free_port()
+    all_eps: List[str] = [
+        f"{host}:{base_port + n * nproc_per_node + l}"
+        for n in range(nnodes) for l in range(nproc_per_node)
+    ]
 
     def cmd(rank_local: int) -> List[str]:
         return [sys.executable, script, *script_args]
@@ -98,6 +102,7 @@ def launch(script: str, script_args: Sequence[str] = (),
             import subprocess
 
             procs = []
+            files = []
             for local in range(nproc_per_node):
                 rank = first_rank + local
                 env = build_env(rank, world_size, all_eps)
@@ -108,12 +113,13 @@ def launch(script: str, script_args: Sequence[str] = (),
                     os.makedirs(self.log_dir, exist_ok=True)
                     f = open(os.path.join(self.log_dir,
                                           f"workerlog.{rank}"), "ab")
+                    files.append(f)
                     stdout = stderr = f
                 procs.append(subprocess.Popen(
                     self.cmd_builder(local), env=env,
                     stdout=stdout, stderr=stderr,
                 ))
-            return Watcher(procs)
+            return Watcher(procs, owned_files=files)
 
     sup = _NodeSupervisor(builder, world_size, all_eps,
                           max_restarts=elastic, log_dir=log_dir)

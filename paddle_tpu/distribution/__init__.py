@@ -1,0 +1,273 @@
+"""paddle.distribution parity (reference: python/paddle/distribution/ —
+Distribution base + Normal/Uniform/Categorical/Bernoulli/… and
+kl_divergence). jax.random-backed sampling; log_prob/entropy as taped ops.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import random as _random
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Exponential", "Laplace", "LogNormal", "kl_divergence",
+           "register_kl"]
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not isinstance(
+        x, jnp.ndarray) else x
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        lp = self.log_prob(value)
+        return apply_op(jnp.exp, lp)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(_random.op_key(), shape, jnp.float32)
+        return Tensor._wrap(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        loc, scale = self.loc, self.scale
+        return apply_op(
+            lambda v: -((v - loc) ** 2) / (2 * scale ** 2)
+            - jnp.log(scale) - 0.5 * math.log(2 * math.pi),
+            value,
+        )
+
+    def entropy(self):
+        return Tensor._wrap(
+            0.5 + 0.5 * math.log(2 * math.pi)
+            + jnp.log(self.scale) * jnp.ones(self.batch_shape))
+
+    @property
+    def mean(self):
+        return Tensor._wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor._wrap(
+            jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _arr(low)
+        self.high = _arr(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_random.op_key(), shape, jnp.float32)
+        return Tensor._wrap(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        low, high = self.low, self.high
+        return apply_op(
+            lambda v: jnp.where((v >= low) & (v < high),
+                                -jnp.log(high - low), -jnp.inf), value)
+
+    def entropy(self):
+        return Tensor._wrap(jnp.log(self.high - self.low)
+                            * jnp.ones(self.batch_shape))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is None and probs is None:
+            raise ValueError("need logits or probs")
+        if logits is not None:
+            self.logits = _arr(logits)
+        else:
+            self.logits = jnp.log(jnp.clip(_arr(probs), 1e-30))
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return Tensor._wrap(jax.nn.softmax(self.logits, axis=-1))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.categorical(
+            _random.op_key(), self.logits, shape=shape))
+
+    def log_prob(self, value):
+        logits = self.logits
+        return apply_op(
+            lambda v: jnp.take_along_axis(
+                jax.nn.log_softmax(logits, axis=-1),
+                v[..., None].astype(jnp.int32), axis=-1)[..., 0],
+            value,
+        )
+
+    def entropy(self):
+        p = jax.nn.softmax(self.logits, axis=-1)
+        lp = jax.nn.log_softmax(self.logits, axis=-1)
+        return Tensor._wrap(-jnp.sum(p * lp, axis=-1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.bernoulli(
+            _random.op_key(), self.probs_arr, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+        return apply_op(
+            lambda v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p), value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+        return Tensor._wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.exponential(
+            _random.op_key(), shape, jnp.float32) / self.rate)
+
+    def log_prob(self, value):
+        rate = self.rate
+        return apply_op(lambda v: jnp.log(rate) - rate * v, value)
+
+    def entropy(self):
+        return Tensor._wrap(1.0 - jnp.log(self.rate))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(self.loc + self.scale * jax.random.laplace(
+            _random.op_key(), shape, jnp.float32))
+
+    def log_prob(self, value):
+        loc, scale = self.loc, self.scale
+        return apply_op(
+            lambda v: -jnp.abs(v - loc) / scale
+            - jnp.log(2 * scale), value)
+
+    def entropy(self):
+        return Tensor._wrap(1.0 + jnp.log(2 * self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    def sample(self, shape=(), seed=0):
+        return Tensor._wrap(jnp.exp(self._normal.sample(shape)._data))
+
+    def log_prob(self, value):
+        loc, scale = self.loc, self.scale
+        return apply_op(
+            lambda v: -((jnp.log(v) - loc) ** 2) / (2 * scale ** 2)
+            - jnp.log(v * scale) - 0.5 * math.log(2 * math.pi), value)
+
+
+# ------------------------------------------------------------ KL registry --
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Reference: paddle.distribution.register_kl decorator."""
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor._wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor._wrap(
+        jnp.log((q.high - q.low) / (p.high - p.low))
+        + jnp.where((q.low <= p.low) & (p.high <= q.high), 0.0, jnp.inf))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    pp = jax.nn.softmax(p.logits, axis=-1)
+    return Tensor._wrap(jnp.sum(
+        pp * (jax.nn.log_softmax(p.logits, -1)
+              - jax.nn.log_softmax(q.logits, -1)), axis=-1))

@@ -1,0 +1,67 @@
+"""paddle.fft parity (reference: python/paddle/fft.py — thin wrappers over
+the C++ fft kernels; here jnp.fft, which XLA lowers natively on TPU).
+Differentiable through the tape via apply_op."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _wrap1(jnp_fn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda a: jnp_fn(a, n=n, axis=axis, norm=norm), x)
+
+    return op
+
+
+def _wrap2(jnp_fn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), x)
+
+    return op
+
+
+def _wrapn(jnp_fn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(lambda a: jnp_fn(a, s=s, axes=axes, norm=norm), x)
+
+    return op
+
+
+fft = _wrap1(jnp.fft.fft)
+ifft = _wrap1(jnp.fft.ifft)
+rfft = _wrap1(jnp.fft.rfft)
+irfft = _wrap1(jnp.fft.irfft)
+hfft = _wrap1(jnp.fft.hfft)
+ihfft = _wrap1(jnp.fft.ihfft)
+fft2 = _wrap2(jnp.fft.fft2)
+ifft2 = _wrap2(jnp.fft.ifft2)
+rfft2 = _wrap2(jnp.fft.rfft2)
+irfft2 = _wrap2(jnp.fft.irfft2)
+fftn = _wrapn(jnp.fft.fftn)
+ifftn = _wrapn(jnp.fft.ifftn)
+rfftn = _wrapn(jnp.fft.rfftn)
+irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.fft.fftfreq(n, d).astype(dtype or "float32"))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    return Tensor._wrap(jnp.fft.rfftfreq(n, d).astype(dtype or "float32"))
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), x)

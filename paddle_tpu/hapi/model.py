@@ -91,14 +91,18 @@ class Model:
     def _update_metrics(self, outs, labels):
         if not self._metrics:
             return
+        import warnings
+
         labels_t = [_to_tensor(l) for l in _as_list(labels)]
         for m in self._metrics:
             try:
                 corr = m.compute(*_as_list(outs), *labels_t)
                 m.update(np.asarray(jax.device_get(
                     corr._data if isinstance(corr, Tensor) else corr)))
-            except Exception:
-                pass
+            except Exception as e:  # surface, don't abort the eval loop
+                warnings.warn(
+                    f"metric {type(m).__name__} failed: {e!r}; its "
+                    "accumulated value will be unreliable")
 
     # ------------------------------------------------------------------ fit
     def fit(self, train_data=None, eval_data=None, batch_size: int = 1,

@@ -69,8 +69,10 @@ class BertPooler(nn.Layer):
         self.dense = nn.Linear(config.hidden_size, config.hidden_size)
 
     def forward(self, hidden):
-        h = hidden._data if isinstance(hidden, Tensor) else hidden
-        return F.tanh(self.dense(Tensor._wrap(h[:, 0])))
+        from ..framework.tensor import apply_op
+
+        cls_tok = apply_op(lambda h: h[:, 0], hidden)  # taped slice
+        return F.tanh(self.dense(cls_tok))
 
 
 class BertModel(nn.Layer):
@@ -122,10 +124,12 @@ class BertLMPredictionHead(nn.Layer):
             shape=[config.vocab_size], is_bias=True)
 
     def forward(self, hidden):
+        from ..framework.tensor import apply_op
+
         x = self.layer_norm(getattr(F, self.activation)(self.transform(hidden)))
-        xd = x._data if isinstance(x, Tensor) else x
-        w = self._tied._data  # [vocab, hidden]
-        return Tensor._wrap(xd @ w.T) + self.decoder_bias
+        # taped tied-weight matmul (same pattern as models/gpt.py LM head)
+        logits = apply_op(lambda a, w: a @ w.T, x, self._tied)
+        return logits + self.decoder_bias
 
 
 class BertForMaskedLM(nn.Layer):
@@ -149,18 +153,22 @@ class BertPretrainingCriterion(nn.Layer):
         self.vocab_size = vocab_size
 
     def forward(self, prediction_scores, masked_lm_labels):
-        logits = (prediction_scores._data
-                  if isinstance(prediction_scores, Tensor)
-                  else prediction_scores)
-        labels = (masked_lm_labels._data
-                  if isinstance(masked_lm_labels, Tensor)
-                  else masked_lm_labels)
         import jax
 
-        valid = labels >= 0
-        safe = jnp.where(valid, labels, 0)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
-        per_tok = jnp.where(valid, logz - gold, 0.0)
-        denom = jnp.maximum(jnp.sum(valid), 1)
-        return Tensor._wrap(jnp.sum(per_tok) / denom)
+        from ..framework.tensor import apply_op
+
+        labels = (masked_lm_labels._data
+                  if isinstance(masked_lm_labels, Tensor)
+                  else jnp.asarray(masked_lm_labels))
+
+        def fn(logits):
+            valid = labels >= 0
+            safe = jnp.where(valid, labels, 0)
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, safe[..., None],
+                                       axis=-1)[..., 0]
+            per_tok = jnp.where(valid, logz - gold, 0.0)
+            denom = jnp.maximum(jnp.sum(valid), 1)
+            return jnp.sum(per_tok) / denom
+
+        return apply_op(fn, prediction_scores)

@@ -1,0 +1,127 @@
+"""paddle.signal parity (reference: python/paddle/signal.py — stft/istft
+over the frame + fft kernels)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor, apply_op
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slide frames of ``frame_length`` every ``hop_length`` (reference:
+    paddle.signal.frame; output [..., frame_length, num_frames])."""
+    def fn(a):
+        if axis not in (-1, a.ndim - 1):
+            a = jnp.moveaxis(a, axis, -1)
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        out = a[..., idx]  # [..., num, frame_length]
+        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+
+    return apply_op(fn, x)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame (reference: paddle.signal.overlap_add; input
+    [..., frame_length, num_frames])."""
+    def fn(a):
+        frame_length, num = a.shape[-2], a.shape[-1]
+        n = (num - 1) * hop_length + frame_length
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for i in range(num):  # static unroll: num is a trace constant
+            out = out.at[..., i * hop_length: i * hop_length + frame_length
+                         ].add(a[..., i])
+        return out
+
+    return apply_op(fn, x)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Reference: paddle.signal.stft. Returns [..., n_fft//2+1 or n_fft,
+    num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = _arr(window)
+
+    def fn(a):
+        w = (jnp.ones(win_length, a.dtype) if window is None else window)
+        if win_length < n_fft:  # centre-pad window to n_fft
+            lpad = (n_fft - win_length) // 2
+            wp = jnp.zeros(n_fft, a.dtype).at[lpad:lpad + win_length].set(w)
+        else:
+            wp = w[:n_fft]
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[..., idx] * wp  # [..., num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num]
+
+    return apply_op(fn, x)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    """Reference: paddle.signal.istft (overlap-add with window-square
+    normalization)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is not None:
+        window = _arr(window)
+
+    def fn(spec):
+        w = (jnp.ones(win_length, jnp.float32) if window is None
+             else window.astype(jnp.float32))
+        if win_length < n_fft:
+            lpad = (n_fft - win_length) // 2
+            wp = jnp.zeros(n_fft, jnp.float32).at[
+                lpad:lpad + win_length].set(w)
+        else:
+            wp = w[:n_fft]
+        frames_fd = jnp.swapaxes(spec, -1, -2)  # [..., num, freq]
+        frames = (jnp.fft.irfft(frames_fd, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(frames_fd, axis=-1).real)
+        if normalized:
+            frames = frames * jnp.sqrt(jnp.asarray(n_fft, frames.dtype))
+        frames = frames * wp
+        num = frames.shape[-2]
+        n = (num - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (n,), frames.dtype)
+        wsum = jnp.zeros(n, frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop_length, i * hop_length + n_fft)
+            out = out.at[..., sl].add(frames[..., i, :])
+            wsum = wsum.at[sl].add(wp ** 2)
+        out = out / jnp.where(wsum > 1e-10, wsum, 1.0)
+        if center:
+            out = out[..., n_fft // 2: n - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return apply_op(fn, x)

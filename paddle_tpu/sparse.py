@@ -1,0 +1,141 @@
+"""paddle.sparse parity — minimal COO/CSR surface (reference:
+python/paddle/sparse/ — sparse_coo_tensor, sparse_csr_tensor, to_dense,
+values/indices, sparse matmul/add).
+
+TPU note: XLA has no native sparse storage; sparse tensors hold coordinate
+data and lower to dense/gather-scatter ops (fine for the API-parity tier —
+SURVEY.md B17 long tail; true sparse kernels would be Pallas work)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "matmul", "add", "is_sparse"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self._indices = jnp.asarray(_arr(indices), jnp.int32)  # [ndim, nnz]
+        self._values = _arr(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    def indices(self):
+        return Tensor._wrap(self._indices)
+
+    def values(self):
+        return Tensor._wrap(self._values)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def to_dense(self):
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        dense = dense.at[tuple(self._indices)].add(self._values)
+        return Tensor._wrap(dense)
+
+    def coalesce(self):
+        """Merge duplicate coordinates (reference: coalesce op)."""
+        flat = jnp.ravel_multi_index(tuple(self._indices), self._shape,
+                                     mode="clip")
+        order = jnp.argsort(flat)
+        flat_s = flat[order]
+        vals_s = self._values[order]
+        uniq, inv = jnp.unique(flat_s, return_inverse=True,
+                               size=flat_s.shape[0], fill_value=-1)
+        summed = jnp.zeros((uniq.shape[0],) + vals_s.shape[1:],
+                           vals_s.dtype).at[inv].add(vals_s)
+        keep = np.asarray(uniq) >= 0
+        uniq_np = np.asarray(uniq)[keep]
+        idx = np.stack(np.unravel_index(uniq_np, self._shape))
+        return SparseCooTensor(idx, jnp.asarray(np.asarray(summed)[keep]),
+                               self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self._values.dtype})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_arr(crows), jnp.int32)
+        self._cols = jnp.asarray(_arr(cols), jnp.int32)
+        self._values = _arr(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    def crows(self):
+        return Tensor._wrap(self._crows)
+
+    def cols(self):
+        return Tensor._wrap(self._cols)
+
+    def values(self):
+        return Tensor._wrap(self._values)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def to_dense(self):
+        rows = np.repeat(
+            np.arange(self._shape[0]),
+            np.diff(np.asarray(self._crows)))
+        dense = jnp.zeros(self._shape, self._values.dtype)
+        dense = dense.at[jnp.asarray(rows), self._cols].add(self._values)
+        return Tensor._wrap(dense)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = jnp.asarray(_arr(indices), jnp.int32)
+    vals = _arr(values)
+    if dtype is not None:
+        from .framework import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=1))
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _arr(values)
+    if dtype is not None:
+        from .framework import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def matmul(x, y):
+    """sparse @ dense (reference: paddle.sparse.matmul)."""
+    xd = x.to_dense()._data if is_sparse(x) else _arr(x)
+    yd = y.to_dense()._data if is_sparse(y) else _arr(y)
+    return Tensor._wrap(xd @ yd)
+
+
+def add(x, y):
+    xd = x.to_dense()._data if is_sparse(x) else _arr(x)
+    yd = y.to_dense()._data if is_sparse(y) else _arr(y)
+    return Tensor._wrap(xd + yd)

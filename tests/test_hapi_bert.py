@@ -154,3 +154,36 @@ class TestHapiModel:
         w1 = np.asarray(dict(net.named_parameters())["weight"]._data)
         w2 = np.asarray(dict(net2.named_parameters())["weight"]._data)
         np.testing.assert_allclose(w1, w2)
+
+
+class TestBertEager:
+    def test_eager_backward_reaches_encoder(self, rng):
+        """Eager loss.backward() through criterion + tied head + pooler path
+        (regression: raw-array wrapping cut the tape)."""
+        model = BertForMaskedLM(CFG)
+        model.train()
+        crit = BertPretrainingCriterion(CFG.vocab_size)
+        ids = paddle.to_tensor(
+            jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32))
+        labels = np.full((2, 8), -100, np.int32)
+        labels[:, :2] = np.asarray(ids._data)[:, :2]
+        loss = crit(model(ids), paddle.to_tensor(jnp.asarray(labels)))
+        loss.backward()
+        named = dict(model.named_parameters())
+        emb = named["bert.embeddings.word_embeddings.weight"]
+        assert emb.grad is not None
+        assert float(jnp.max(jnp.abs(emb.grad._data))) > 0
+        enc = [p for n, p in named.items() if "encoder" in n and p.grad is not None]
+        assert enc, "no encoder grads"
+
+    def test_pooler_eager_grads(self, rng):
+        model = BertModel(CFG)
+        model.train()
+        ids = paddle.to_tensor(
+            jnp.asarray(rng.integers(0, 64, (2, 8)), jnp.int32))
+        _, pooled = model(ids)
+        (pooled * pooled).mean().backward()
+        named = dict(model.named_parameters())
+        emb = named["embeddings.word_embeddings.weight"]
+        assert emb.grad is not None
+        assert float(jnp.max(jnp.abs(emb.grad._data))) > 0

@@ -1,0 +1,132 @@
+"""Long-tail namespace tests: fft, distribution, sparse, signal
+(SURVEY.md B17)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+
+
+class TestFFT:
+    def test_fft_roundtrip(self, rng):
+        x = paddle.to_tensor(
+            jnp.asarray(rng.standard_normal((4, 16)), jnp.float32))
+        X = paddle.fft.fft(x)
+        back = paddle.fft.ifft(X)
+        np.testing.assert_allclose(np.asarray(back._data).real,
+                                   np.asarray(x._data), atol=1e-5)
+
+    def test_rfft_matches_numpy(self, rng):
+        a = rng.standard_normal((8, 32)).astype(np.float32)
+        out = paddle.fft.rfft(paddle.to_tensor(jnp.asarray(a)))
+        np.testing.assert_allclose(np.asarray(out._data),
+                                   np.fft.rfft(a), atol=1e-4)
+
+    def test_fft_gradient(self, rng):
+        x = paddle.to_tensor(
+            jnp.asarray(rng.standard_normal((16,)), jnp.float32))
+        x.stop_gradient = False
+        y = paddle.fft.rfft(x)
+        mag = (y.abs() ** 2).sum()
+        mag.backward()
+        assert x.grad is not None
+        # Parseval: d/dx sum|X|^2 = 2*N'*x-ish — just require nonzero finite
+        g = np.asarray(x.grad._data)
+        assert np.all(np.isfinite(g)) and np.abs(g).max() > 0
+
+    def test_fftfreq_shift(self):
+        f = paddle.fft.fftfreq(8, d=0.5)
+        np.testing.assert_allclose(np.asarray(f._data),
+                                   np.fft.fftfreq(8, 0.5))
+        x = paddle.to_tensor(jnp.arange(8.0))
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.fftshift(x)._data),
+            np.fft.fftshift(np.arange(8.0)))
+
+
+class TestDistribution:
+    def test_normal(self, rng):
+        d = paddle.distribution.Normal(0.0, 2.0)
+        s = d.sample((1000,))
+        assert abs(float(s._data.std()) - 2.0) < 0.3
+        lp = d.log_prob(paddle.to_tensor(jnp.asarray([0.0])))
+        expect = -np.log(2.0) - 0.5 * np.log(2 * np.pi)
+        np.testing.assert_allclose(float(lp._data[0]), expect, rtol=1e-5)
+
+    def test_kl_normal(self):
+        p = paddle.distribution.Normal(0.0, 1.0)
+        q = paddle.distribution.Normal(1.0, 1.0)
+        kl = paddle.distribution.kl_divergence(p, q)
+        np.testing.assert_allclose(float(kl._data), 0.5, rtol=1e-5)
+
+    def test_categorical(self, rng):
+        logits = jnp.asarray([[0.0, 0.0, 10.0]])
+        d = paddle.distribution.Categorical(logits=logits)
+        s = d.sample((50,))
+        assert (np.asarray(s._data) == 2).mean() > 0.95
+        lp = d.log_prob(paddle.to_tensor(jnp.asarray([2])))
+        assert float(lp._data[0]) > -0.01
+
+    def test_uniform_entropy_bernoulli(self):
+        u = paddle.distribution.Uniform(0.0, 4.0)
+        np.testing.assert_allclose(float(u.entropy()._data), np.log(4.0),
+                                   rtol=1e-6)
+        b = paddle.distribution.Bernoulli(0.5)
+        np.testing.assert_allclose(float(b.entropy()._data), np.log(2.0),
+                                   rtol=1e-4)
+
+
+class TestSparse:
+    def test_coo_to_dense_and_matmul(self):
+        idx = np.array([[0, 1, 1], [1, 0, 2]])
+        vals = np.array([3.0, 4.0, 5.0], np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, (2, 3))
+        dense = np.zeros((2, 3), np.float32)
+        dense[0, 1], dense[1, 0], dense[1, 2] = 3, 4, 5
+        np.testing.assert_allclose(np.asarray(sp.to_dense()._data), dense)
+
+        y = np.ones((3, 2), np.float32)
+        out = paddle.sparse.matmul(sp, paddle.to_tensor(jnp.asarray(y)))
+        np.testing.assert_allclose(np.asarray(out._data), dense @ y)
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0], [1, 1]])
+        vals = np.array([1.0, 2.0], np.float32)
+        sp = paddle.sparse.sparse_coo_tensor(idx, vals, (2, 2)).coalesce()
+        assert sp.nnz() == 1
+        np.testing.assert_allclose(
+            np.asarray(sp.to_dense()._data)[0, 1], 3.0)
+
+    def test_csr(self):
+        sp = paddle.sparse.sparse_csr_tensor(
+            [0, 1, 3], [1, 0, 2], np.array([3.0, 4.0, 5.0], np.float32),
+            (2, 3))
+        dense = np.zeros((2, 3), np.float32)
+        dense[0, 1], dense[1, 0], dense[1, 2] = 3, 4, 5
+        np.testing.assert_allclose(np.asarray(sp.to_dense()._data), dense)
+
+
+class TestSignal:
+    def test_stft_istft_roundtrip(self, rng):
+        x = rng.standard_normal((2, 256)).astype(np.float32)
+        n_fft, hop = 64, 16
+        win = np.hanning(n_fft).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(jnp.asarray(x)), n_fft,
+                                  hop_length=hop,
+                                  window=paddle.to_tensor(jnp.asarray(win)))
+        assert spec._data.shape == (2, n_fft // 2 + 1,
+                                    1 + 256 // hop)
+        back = paddle.signal.istft(spec, n_fft, hop_length=hop,
+                                   window=paddle.to_tensor(jnp.asarray(win)),
+                                   length=256)
+        np.testing.assert_allclose(np.asarray(back._data)[:, hop:-hop],
+                                   x[:, hop:-hop], atol=1e-4)
+
+    def test_frame_overlap_add(self, rng):
+        x = rng.standard_normal((64,)).astype(np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(jnp.asarray(x)), 16, 16)
+        assert f._data.shape == (16, 4)
+        back = paddle.signal.overlap_add(f, 16)
+        np.testing.assert_allclose(np.asarray(back._data), x, atol=1e-6)

@@ -35,15 +35,27 @@ from ..framework.tensor import Tensor
 __all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
            "AsyncCheckpointer"]
 
-_METADATA = "metadata.json"
-
 
 def _unwrap(v):
     return v._data if isinstance(v, Tensor) else v
 
 
 def _sanitize(name: str) -> str:
-    return name.replace("/", "_").replace("\\", "_")
+    """Filesystem-safe, collision-free: separators become '_' and a short
+    hash of the ORIGINAL name disambiguates 'a/b' from 'a_b'."""
+    import hashlib
+
+    safe = name.replace("/", "_").replace("\\", "_")
+    tag = hashlib.sha1(name.encode()).hexdigest()[:8]
+    return f"{safe}.{tag}"
+
+
+def _jsonable(v):
+    """Python-native scalars survive the JSON round-trip; numpy scalars are
+    converted (json.dump(default=str) would silently stringify them)."""
+    if isinstance(v, (np.generic,)):
+        return v.item()
+    return v
 
 
 def _collect_chunks(name: str, arr) -> List[Dict[str, Any]]:
@@ -78,15 +90,19 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     """
     os.makedirs(path, exist_ok=True)
     pidx = jax.process_index() if process_index is None else process_index
+    pcount = jax.process_count()
 
     # snapshot to host NOW (async correctness: later mutations of the live
     # params must not leak into the checkpoint)
     plan: List[Dict[str, Any]] = []
-    meta: Dict[str, Any] = {"tensors": {}, "format": "paddle_tpu.dist_ckpt.v1"}
+    meta: Dict[str, Any] = {"tensors": {}, "objects": {},
+                            "format": "paddle_tpu.dist_ckpt.v1",
+                            "process_index": pidx,
+                            "process_count": pcount}
     for name, v in state_dict.items():
         arr = _unwrap(v)
         if not isinstance(arr, (jax.Array, np.ndarray, jnp.ndarray)):
-            meta.setdefault("objects", {})[name] = arr  # small python values
+            meta["objects"][name] = _jsonable(arr)  # small python values
             continue
         jarr = arr if isinstance(arr, jax.Array) else jnp.asarray(arr)
         chunks = _collect_chunks(name, jarr)
@@ -107,17 +123,20 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     def _write():
         for item in plan:
             np.save(item["file"], item["data"], allow_pickle=False)
-        # metadata last = commit marker (readers treat its presence as a
-        # complete checkpoint)
-        if pidx == 0:
-            with open(os.path.join(path, _METADATA), "w") as f:
-                json.dump(meta, f, default=str)
+        # per-process metadata written LAST = that process's commit marker;
+        # the checkpoint is complete when all process_count markers exist
+        # (multi-host: every process records only its addressable chunks;
+        # the loader merges all metadata.p*.json)
+        with open(os.path.join(path, f"metadata.p{pidx}.json"), "w") as f:
+            json.dump(meta, f)
 
     if async_save:
-        t = threading.Thread(target=_write, daemon=True,
-                             name="ckpt-writer")
+        handle = AsyncSaveHandle(None)
+        t = threading.Thread(target=handle._run, args=(_write,),
+                             daemon=True, name="ckpt-writer")
+        handle._thread = t
         t.start()
-        return AsyncSaveHandle(t)
+        return handle
     _write()
     return AsyncSaveHandle(None)
 
@@ -128,14 +147,33 @@ def load_state_dict(path: str, shardings: Optional[Dict[str, Any]] = None,
     """Load a sharded checkpoint, optionally RE-SHARDING each tensor:
     ``shardings`` maps name → jax.sharding.Sharding (or pass ``mesh`` +
     ``specs`` name → PartitionSpec). Unlisted tensors load replicated."""
+    import glob
+
     from jax.sharding import NamedSharding
 
-    meta_path = os.path.join(path, _METADATA)
-    if not os.path.exists(meta_path):
+    metas = []
+    for mp in sorted(glob.glob(os.path.join(path, "metadata.p*.json"))):
+        with open(mp) as f:
+            metas.append(json.load(f))
+    if not metas:
         raise FileNotFoundError(
-            f"{meta_path} missing — incomplete or non-dist checkpoint")
-    with open(meta_path) as f:
-        meta = json.load(f)
+            f"no metadata.p*.json under {path} — incomplete or non-dist "
+            "checkpoint")
+    expect = metas[0].get("process_count", 1)
+    if len(metas) < expect:
+        raise FileNotFoundError(
+            f"checkpoint incomplete: {len(metas)}/{expect} process commit "
+            f"markers present under {path}")
+    # merge: tensors' chunk lists union across processes; objects from p0
+    merged: Dict[str, Any] = {"tensors": {}, "objects": {}}
+    for m in metas:
+        merged["objects"].update(m.get("objects", {}))
+        for name, info in m.get("tensors", {}).items():
+            slot = merged["tensors"].setdefault(
+                name, {"global_shape": info["global_shape"],
+                       "dtype": info["dtype"], "chunks": []})
+            slot["chunks"].extend(info["chunks"])
+    meta = merged
     out: Dict[str, Any] = dict(meta.get("objects", {}))
     for name, info in meta["tensors"].items():
         full = np.zeros(tuple(info["global_shape"]),
@@ -158,6 +196,13 @@ def load_state_dict(path: str, shardings: Optional[Dict[str, Any]] = None,
 class AsyncSaveHandle:
     def __init__(self, thread: Optional[threading.Thread]):
         self._thread = thread
+        self._error: Optional[BaseException] = None
+
+    def _run(self, fn):
+        try:
+            fn()
+        except BaseException as e:  # surfaced on wait(), never swallowed
+            self._error = e
 
     @property
     def done(self) -> bool:
@@ -166,6 +211,9 @@ class AsyncSaveHandle:
     def wait(self):
         if self._thread is not None:
             self._thread.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
 
 class AsyncCheckpointer:

@@ -155,7 +155,11 @@ class _PyClient:
             status, olen = struct.unpack("<qI", hdr)
             out = b""
             while len(out) < olen:
-                out += self._sock.recv(olen - len(out))
+                chunk = self._sock.recv(olen - len(out))
+                if not chunk:
+                    raise ConnectionError(
+                        "TCPStore server closed mid-response")
+                out += chunk
         return status, out
 
     def close(self):
@@ -192,6 +196,12 @@ class _NativeClient:
                 olen = ct.c_uint32(0)
                 status = self._lib.ts_get(self._h, key.encode(), timeout_ms,
                                           out, cap, ct.byref(olen))
+                if status == -203:  # buffer too small: retry at actual size
+                    cap = olen.value
+                    out = (ct.c_uint8 * cap)()
+                    status = self._lib.ts_get(self._h, key.encode(),
+                                              timeout_ms, out, cap,
+                                              ct.byref(olen))
                 return status, bytes(out[: olen.value])
             if op == 2:
                 (delta,) = struct.unpack("<q", val)
@@ -277,11 +287,17 @@ class TCPStore:
 
     def barrier(self, name: str = "default", timeout: float = 60.0):
         """All ``world_size`` participants block until everyone arrives
-        (reference scheme: counter + release key)."""
-        arrived = self.add(f"__barrier/{name}/count", 1)
+        (reference scheme: counter + release key). Reusable: each call on a
+        name is a new epoch — participants make the same sequence of calls,
+        so their local epoch counters agree."""
+        epochs = self.__dict__.setdefault("_barrier_epochs", {})
+        epoch = epochs.get(name, 0)
+        epochs[name] = epoch + 1
+        prefix = f"__barrier/{name}/{epoch}"
+        arrived = self.add(f"{prefix}/count", 1)
         if arrived == self.world_size:
-            self.set(f"__barrier/{name}/release", b"1")
-        self.get(f"__barrier/{name}/release", timeout)
+            self.set(f"{prefix}/release", b"1")
+        self.get(f"{prefix}/release", timeout)
 
     def close(self):
         if getattr(self, "_closed", False):

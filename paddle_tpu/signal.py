@@ -20,14 +20,21 @@ def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
     """Slide frames of ``frame_length`` every ``hop_length`` (reference:
     paddle.signal.frame; output [..., frame_length, num_frames])."""
     def fn(a):
-        if axis not in (-1, a.ndim - 1):
+        moved = axis not in (-1, a.ndim - 1)
+        if moved:
             a = jnp.moveaxis(a, axis, -1)
         n = a.shape[-1]
         num = 1 + (n - frame_length) // hop_length
         starts = jnp.arange(num) * hop_length
         idx = starts[:, None] + jnp.arange(frame_length)[None, :]
         out = a[..., idx]  # [..., num, frame_length]
-        return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+        out = jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+        if moved:
+            # restore the reference layout: framed axis pair goes back where
+            # the original axis was ((frame_length, num_frames) leading for
+            # axis=0 — paddle.signal.frame semantics)
+            out = jnp.moveaxis(out, (-2, -1), (axis, axis + 1))
+        return out
 
     return apply_op(fn, x)
 

@@ -119,3 +119,74 @@ class TestAutoParallel:
         pm = ProcessMesh(np.arange(8), dim_names=["x"])
         with pytest.raises(NotImplementedError):
             shard_tensor(paddle.to_tensor(jnp.ones((4,))), pm, [Partial()])
+
+
+class TestCheckpointRegressions:
+    def test_async_write_failure_surfaces(self, tmp_path, rng):
+        """A failed background write must raise on wait(), not vanish."""
+        from paddle_tpu.distributed import save_state_dict
+
+        target = tmp_path / "ck"
+        h = save_state_dict({"w": jnp.ones((4,))}, str(target),
+                            async_save=True)
+        h.wait()  # baseline fine
+        # unwritable path → the async thread must capture and re-raise
+        bad = tmp_path / "file_not_dir"
+        bad.write_text("x")
+        with pytest.raises((RuntimeError, OSError, NotADirectoryError)):
+            h2 = save_state_dict({"w": jnp.ones((4,))},
+                                 str(bad / "nested"), async_save=True)
+            h2.wait()
+
+    def test_name_collision_safe(self, tmp_path):
+        from paddle_tpu.distributed import load_state_dict, save_state_dict
+
+        sd = {"layer/w": jnp.ones((2,)), "layer_w": jnp.zeros((2,))}
+        save_state_dict(sd, str(tmp_path / "ck"))
+        out = load_state_dict(str(tmp_path / "ck"))
+        np.testing.assert_allclose(np.asarray(out["layer/w"]), 1.0)
+        np.testing.assert_allclose(np.asarray(out["layer_w"]), 0.0)
+
+    def test_numpy_scalar_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed import load_state_dict, save_state_dict
+
+        save_state_dict({"step": np.int64(7), "lr": np.float32(0.1),
+                         "w": jnp.ones((2,))}, str(tmp_path / "ck"))
+        out = load_state_dict(str(tmp_path / "ck"))
+        assert out["step"] == 7 and isinstance(out["step"], int)
+        assert abs(out["lr"] - 0.1) < 1e-6
+
+
+class TestTCPStoreBarrierReuse:
+    def test_barrier_reusable(self):
+        import socket
+        import threading
+        import time as _time
+
+        from paddle_tpu.distributed import TCPStore
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        a = TCPStore("127.0.0.1", port, is_master=True, world_size=2)
+        b = TCPStore("127.0.0.1", port, world_size=2)
+        try:
+            order = []
+
+            def side(store, tag, delays):
+                for i, d in enumerate(delays):
+                    _time.sleep(d)
+                    store.barrier("r", timeout=15)
+                    order.append((tag, i, _time.monotonic()))
+
+            t1 = threading.Thread(target=side, args=(a, "a", [0.0, 0.25]))
+            t2 = threading.Thread(target=side, args=(b, "b", [0.2, 0.0]))
+            t1.start(); t2.start(); t1.join(20); t2.join(20)
+            assert len(order) == 4
+            # round 2: nobody passed before BOTH arrived at round 2
+            r2 = [t for tag, i, t in order if i == 1]
+            r1 = [t for tag, i, t in order if i == 0]
+            assert min(r2) >= max(r1) - 1e-3
+        finally:
+            a.close()
+            b.close()

@@ -1,0 +1,146 @@
+"""Config 4: GPT hybrid pretraining — mp × pp × dp (+ ZeRO via sharding
+axis), compiled pipeline schedule, distributed checkpoint, MFU readout.
+
+Tiny mode runs dp2×pp2×mp2 on 8 virtual devices; --real documents the
+6.7B / v5p-128 shape (mp8 × pp4 × sharding4, bf16, remat) — SURVEY.md §6.
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, profiler
+from paddle_tpu.distributed import fleet, save_state_dict
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    ColumnParallelLinear,
+    LayerDesc,
+    PipelineLayer,
+    RowParallelLinear,
+)
+from paddle_tpu.framework.tensor import Tensor
+
+import jax
+import jax.numpy as jnp
+
+
+def build_layers(hidden, heads, n_layers, vocab):
+    import paddle_tpu.nn.functional as F
+
+    class Embed(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.word = nn.Embedding(vocab, hidden)
+
+        def forward(self, x):
+            return self.word(x)
+
+    class Block(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln1 = nn.LayerNorm(hidden)
+            self.qkv = ColumnParallelLinear(hidden, 3 * hidden,
+                                            gather_output=False)
+            self.proj = RowParallelLinear(hidden, hidden,
+                                          input_is_parallel=True)
+            self.ln2 = nn.LayerNorm(hidden)
+            self.fc1 = ColumnParallelLinear(hidden, 4 * hidden,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(4 * hidden, hidden,
+                                         input_is_parallel=True)
+            self.heads = heads
+            self.hd = hidden // heads
+
+        def forward(self, x):
+            b, s, h = x.shape
+            qkv = self.qkv(self.ln1(x)).reshape([b, s, 3, self.heads, self.hd])
+            q, k, v = qkv.unbind(axis=2)
+            att, _ = F.flash_attention(q, k, v, causal=True,
+                                       training=self.training)
+            x = x + self.proj(att.reshape([b, s, h]))
+            return x + self.fc2(F.gelu(self.fc1(self.ln2(x))))
+
+    class Head(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.ln = nn.LayerNorm(hidden)
+            self.out = nn.Linear(hidden, vocab)
+
+        def forward(self, x):
+            return self.out(self.ln(x))
+
+    return [LayerDesc(Embed),
+            *[LayerDesc(Block) for _ in range(n_layers)],
+            LayerDesc(Head)]
+
+
+def ce_loss(logits, labels):
+    l = logits._data if isinstance(logits, Tensor) else logits
+    y = labels._data if isinstance(labels, Tensor) else labels
+    l = l.astype(jnp.float32)
+    logz = jax.nn.logsumexp(l, axis=-1)
+    gold = jnp.take_along_axis(l, y[..., None], axis=-1)[..., 0]
+    return Tensor._wrap(jnp.mean(logz - gold))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--real", action="store_true",
+                   help="6.7B-class config (needs a TPU pod slice)")
+    p.add_argument("--steps", type=int, default=5)
+    p.add_argument("--ckpt", type=str, default="")
+    args = p.parse_args()
+
+    if args.real:  # the config-4 shape from SURVEY.md §6
+        dims = dict(mp=8, pp=4, sharding=4)
+        hidden, heads, n_layers, vocab = 4096, 32, 32, 50304
+        batch, seq, micro = 512, 2048, 16
+    else:
+        dims = dict(mp=2, pp=2, sharding=1)
+        hidden, heads, n_layers, vocab = 64, 4, 4, 128
+        batch, seq, micro = 8, 32, 2
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {f"{k}_degree": v for k, v in dims.items()}
+    strategy.pipeline_configs = {"accumulate_steps": micro}
+    strategy.recompute = args.real
+    fleet.init(is_collective=True, strategy=strategy)
+
+    model = PipelineLayer(build_layers(hidden, heads, n_layers, vocab),
+                          num_stages=dims["pp"], loss_fn=ce_loss)
+    engine = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                        grad_clip=nn.ClipGradByGlobalNorm(1.0)))
+
+    n_params = sum(int(np.prod(p_.shape)) for _, p_ in model.named_parameters())
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for step in range(args.steps):
+        ids = paddle.to_tensor(
+            rng.integers(0, vocab, (batch, seq)).astype(np.int32))
+        labels = paddle.to_tensor(
+            rng.integers(0, vocab, (batch, seq)).astype(np.int32))
+        loss = engine.train_batch([ids, labels], opt)
+        if step == 0:
+            t0 = time.time()  # exclude compile
+        print(f"step {step} loss {float(loss._data):.4f}")
+    steps_timed = max(1, args.steps - 1)
+    tps = batch * seq * steps_timed / max(time.time() - t0, 1e-9)
+    readout = profiler.mfu(n_params, tps / jax.device_count())
+    print(f"tokens/s {tps:.0f}  MFU {readout:.3f}  (params {n_params/1e6:.1f}M)")
+
+    if args.ckpt:
+        save_state_dict(
+            {n: p_ for n, p_ in model.named_parameters()}, args.ckpt)
+        print("checkpoint written to", args.ckpt)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Config 2: BERT MLM data-parallel — dp mesh axis, DistributedBatchSampler,
+one compiled step (grads psum'd by GSPMD; reference: DataParallel+Reducer).
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.jit import functional_call, param_arrays
+from paddle_tpu.models.bert import (
+    BertConfig,
+    BertForMaskedLM,
+    BertPretrainingCriterion,
+)
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--real", action="store_true")
+    p.add_argument("--steps", type=int, default=5)
+    args = p.parse_args()
+
+    if args.real:
+        cfg = BertConfig()  # BERT-base
+        batch, seq = 256, 512
+    else:
+        cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                         num_attention_heads=4, intermediate_size=64,
+                         max_position_embeddings=64, hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        batch, seq = 16, 32
+
+    strategy = DistributedStrategy()  # pure dp: auto-infer dp = all devices
+    st = fleet.init(is_collective=True, strategy=strategy)
+    mesh = st.mesh
+
+    model = BertForMaskedLM(cfg)
+    model.train()
+    crit = BertPretrainingCriterion(cfg.vocab_size)
+    opt = optimizer.AdamW(learning_rate=1e-4)
+    params = param_arrays(model)
+    opt_state = opt.init_state_tree(params)
+
+    data_sharding = NamedSharding(mesh, P("dp"))
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, ids, labels, step_i):
+        def loss_fn(p):
+            logits = functional_call(model, p, Tensor._wrap(ids))
+            return crit(Tensor._wrap(logits), Tensor._wrap(labels))._data
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p, new_s = opt.apply_gradients_tree(params, grads, opt_state,
+                                                1e-4, step_i)
+        return new_p, new_s, loss
+
+    rng = np.random.default_rng(0)
+    t0 = time.time()
+    for i in range(args.steps):
+        ids = jax.device_put(
+            jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                        jnp.int32), data_sharding)
+        labels = np.full((batch, seq), -100, np.int32)
+        labels[:, : seq // 8] = np.asarray(ids)[:, : seq // 8]
+        labels = jax.device_put(jnp.asarray(labels), data_sharding)
+        params, opt_state, loss = step(params, opt_state, ids, labels,
+                                       jnp.float32(i + 1))
+        if i == 0:
+            t0 = time.time()
+        print(f"step {i} loss {float(jax.device_get(loss)):.4f}")
+    tps = batch * seq * max(1, args.steps - 1) / max(time.time() - t0, 1e-9)
+    print(f"tokens/s {tps:.0f} over dp={mesh.shape['dp']}")
+
+
+if __name__ == "__main__":
+    main()

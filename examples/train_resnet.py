@@ -1,0 +1,61 @@
+"""Config 1: ResNet single-chip training — eager feel, fully-jitted step.
+
+Tiny mode: ResNet-18 on random data. --real: ResNet-50 / ImageNet shapes.
+"""
+import argparse
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
+
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.io import DataLoader, Dataset
+from paddle_tpu.vision.models import resnet
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--real", action="store_true")
+    p.add_argument("--epochs", type=int, default=1)
+    args = p.parse_args()
+
+    if args.real:
+        net = resnet.resnet50(num_classes=1000)
+        size, classes, n = 224, 1000, 1024
+        batch = 256
+    else:
+        net = resnet.ResNet(resnet.BasicBlock, depth=18, num_classes=10)
+        size, classes, n = 32, 10, 64
+        batch = 16
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((n, 3, size, size)).astype(np.float32)
+    Y = rng.integers(0, classes, (n,)).astype(np.int64)
+
+    class DS(Dataset):
+        def __len__(self):
+            return n
+
+        def __getitem__(self, i):
+            return X[i], Y[i]
+
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                     parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=paddle.metric.Accuracy(),
+    )
+    t0 = time.time()
+    hist = model.fit(DS(), epochs=args.epochs, batch_size=batch, verbose=0)
+    print(f"losses {['%.3f' % l for l in hist['loss']]} "
+          f"({time.time() - t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -67,6 +67,7 @@ _LAZY = {
     "utils": "paddle_tpu.utils",
     "device": "paddle_tpu.device_ns",
     "inference": "paddle_tpu.inference",
+    "tensor": "paddle_tpu.tensor",
     "fft": "paddle_tpu.fft",
     "distribution": "paddle_tpu.distribution",
     "sparse": "paddle_tpu.sparse",

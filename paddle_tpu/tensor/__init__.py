@@ -1,0 +1,7 @@
+"""paddle.tensor namespace parity (reference: python/paddle/tensor/ —
+creation.py, math.py, manipulation.py, linalg.py, random.py re-exported
+at paddle.tensor.*). The implementations live in paddle_tpu.ops."""
+from ..ops.creation import *  # noqa: F401,F403
+from ..ops.manipulation import *  # noqa: F401,F403
+from ..ops.math import *  # noqa: F401,F403
+from ..ops import creation, linalg, manipulation, math  # noqa: F401

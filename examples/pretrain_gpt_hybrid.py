@@ -131,10 +131,14 @@ def main():
         if step == 0:
             t0 = time.time()  # exclude compile
         print(f"step {step} loss {float(loss._data):.4f}")
-    steps_timed = max(1, args.steps - 1)
-    tps = batch * seq * steps_timed / max(time.time() - t0, 1e-9)
-    readout = profiler.mfu(n_params, tps / jax.device_count())
-    print(f"tokens/s {tps:.0f}  MFU {readout:.3f}  (params {n_params/1e6:.1f}M)")
+    if args.steps > 1:
+        steps_timed = args.steps - 1
+        tps = batch * seq * steps_timed / max(time.time() - t0, 1e-9)
+        readout = profiler.mfu(n_params, tps / jax.device_count())
+        print(f"tokens/s {tps:.0f}  MFU {readout:.3f}  "
+              f"(params {n_params/1e6:.1f}M)")
+    else:
+        print("(need --steps > 1 for a timed throughput window)")
 
     if args.ckpt:
         save_state_dict(

@@ -34,7 +34,11 @@ def main():
     args = p.parse_args()
 
     if args.real:
-        cfg = BertConfig()  # BERT-base
+        # dropout 0: under a reused jitted step the PRNG key would be a
+        # trace-time constant (same mask every step) — stochastic-depth
+        # training needs explicit key threading (see models/gpt decode scan)
+        cfg = BertConfig(hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)  # BERT-base
         batch, seq = 256, 512
     else:
         cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,

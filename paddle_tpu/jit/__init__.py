@@ -101,6 +101,27 @@ def _named_state_tensors(layer) -> Dict[str, Tensor]:
     return out
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def swapped_params(layer, arrays):
+    """Swap ``arrays`` (ordered like ``layer.named_parameters()``) into the
+    layer's parameter storage for the duration of a traced region — the
+    multi-call sibling of :func:`functional_call` (which swaps around ONE
+    forward). Used by whole-program traces (generation scan, pipeline
+    engine) that invoke the layer repeatedly inside one trace."""
+    named = list(layer.named_parameters())
+    saved = [p._data for _, p in named]
+    try:
+        for (_, p), a in zip(named, arrays):
+            p._data = a
+        yield
+    finally:
+        for (_, p), d in zip(named, saved):
+            p._data = d
+
+
 def functional_call(
     layer,
     state: Dict[str, Any],

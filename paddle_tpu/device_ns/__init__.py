@@ -133,13 +133,11 @@ class _CudaNS:
 
     @staticmethod
     def max_memory_allocated(device=None):
-        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
-        return int(stats.get("peak_bytes_in_use", 0)) if stats else 0
+        return max_memory_allocated(device if device is not None else 0)
 
     @staticmethod
     def memory_allocated(device=None):
-        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
-        return int(stats.get("bytes_in_use", 0)) if stats else 0
+        return memory_allocated(device if device is not None else 0)
 
     @staticmethod
     def empty_cache():
@@ -147,3 +145,49 @@ class _CudaNS:
 
 
 cuda = _CudaNS()
+
+
+# ---------------------------------------------------------- memory stats ---
+# Reference: paddle.device.cuda.max_memory_allocated / memory_allocated etc.
+# (paddle/fluid/memory/stats.cc). TPU equivalent: PJRT device memory_stats —
+# SURVEY.md A12: "Surface: memory stats API reading PJRT memory_stats()".
+
+
+def _mem_stats(device_id=0):
+    if isinstance(device_id, str):  # paddle-style "tpu:1" / "gpu:0"
+        device_id = int(device_id.rsplit(":", 1)[-1]) if ":" in device_id \
+            else int(device_id)
+    elif not isinstance(device_id, int):
+        device_id = int(getattr(device_id, "id", device_id))
+    d = jax.devices()[device_id]
+    stats = getattr(d, "memory_stats", lambda: None)()
+    return stats or {}
+
+
+def memory_allocated(device_id=0) -> int:
+    """Bytes currently allocated on the device (PJRT bytes_in_use)."""
+    return int(_mem_stats(device_id).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device_id=0) -> int:
+    """High-water allocation mark (PJRT peak_bytes_in_use)."""
+    return int(_mem_stats(device_id).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device_id=0) -> int:
+    """Bytes reserved by the allocator pool (0 when the backend does not
+    report it — bytes_limit is CAPACITY, not a reservation)."""
+    return int(_mem_stats(device_id).get("bytes_reserved", 0))
+
+
+def max_memory_reserved(device_id=0) -> int:
+    return int(_mem_stats(device_id).get("peak_bytes_reserved", 0))
+
+
+def memory_stats(device_id=0) -> dict:
+    """Raw PJRT stats dict (superset of the reference's counters)."""
+    return dict(_mem_stats(device_id))
+
+
+__all__ += ["memory_allocated", "max_memory_allocated", "memory_reserved",
+            "max_memory_reserved", "memory_stats"]

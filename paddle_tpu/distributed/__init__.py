@@ -64,3 +64,4 @@ from .auto_parallel import (  # noqa: F401
     shard_tensor,
 )
 from .store import TCPStore  # noqa: F401
+from . import communication  # noqa: F401

@@ -1,0 +1,19 @@
+"""paddle.distributed.communication parity (reference:
+python/paddle/distributed/communication/ — the op-per-module layout plus
+``stream`` async variants). Implementations live in
+paddle_tpu.distributed.collective."""
+from ..collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    barrier,
+    broadcast,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from . import stream  # noqa: F401

@@ -1,0 +1,51 @@
+"""paddle.distributed.communication.stream parity (reference:
+python/paddle/distributed/communication/stream/ — collectives issued on a
+chosen comm stream, returning waitable tasks).
+
+TPU semantics: XLA owns scheduling; there are no user-visible comm streams
+(SURVEY.md A14 — "latency hiding via XLA's async collective pairs replaces
+comm/compute streams"). These wrappers keep the call shape
+(``sync_op``/``use_calc_stream`` accepted) and return a completed
+:class:`Task` whose ``wait()`` is a no-op, matching the reference contract
+for already-synchronous execution.
+"""
+from __future__ import annotations
+
+from .. import collective as _c
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+           "broadcast", "reduce", "scatter", "send", "recv", "Task"]
+
+
+class Task:
+    """Waitable handle (reference: ProcessGroup::Task). Work is complete by
+    the time the wrapper returns — wait()/is_completed() are trivially
+    satisfied."""
+
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def _wrap(fn):
+    def op(*args, sync_op=True, use_calc_stream=False, **kwargs):
+        kwargs.pop("sync_op", None)
+        fn(*args, **kwargs)
+        return Task()
+
+    op.__name__ = fn.__name__
+    op.__doc__ = f"stream.{fn.__name__} (see collective.{fn.__name__})"
+    return op
+
+
+all_reduce = _wrap(_c.all_reduce)
+all_gather = _wrap(_c.all_gather)
+reduce_scatter = _wrap(_c.reduce_scatter)
+all_to_all = _wrap(_c.all_to_all)
+broadcast = _wrap(_c.broadcast)
+reduce = _wrap(_c.reduce)
+scatter = _wrap(_c.scatter)
+send = _wrap(_c.send)
+recv = _wrap(_c.recv)

@@ -373,8 +373,16 @@ class PipelineParallel(MetaParallelBase):
         if key not in self._step_cache:
             loss_head = self._layers._loss_fn
 
-            def loss_fn(state, x_in, y_in, scale):
-                out = self._pipeline_fwd(state, x_in, M, training=True)
+            def loss_fn(state, x_in, y_in, scale, step_i):
+                from ....framework import random as _random
+
+                # step-dependent dropout inside the reused compiled step:
+                # all op_key() draws derive from fold_in(base, step)
+                with _random.key_context(
+                    jax.random.fold_in(_random.base_key(),
+                                       step_i.astype(jnp.int32))
+                ):
+                    out = self._pipeline_fwd(state, x_in, M, training=True)
                 if loss_head is not None:
                     with pause_tape():
                         loss = loss_head(out, Tensor._wrap(y_in))
@@ -388,7 +396,7 @@ class PipelineParallel(MetaParallelBase):
             def step(state, opt_state, x_in, y_in, lr, step_i, scale):
                 (scaled, loss), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
-                )(state, x_in, y_in, scale)
+                )(state, x_in, y_in, scale, step_i)
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
                 flat = jax.tree_util.tree_leaves(grads)
                 finite = jnp.all(

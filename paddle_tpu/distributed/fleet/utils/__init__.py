@@ -1,3 +1,4 @@
 from . import log_util  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
 from .hybrid_parallel_util import fused_allreduce_gradients  # noqa: F401
+from . import mix_precision_utils  # noqa: F401

@@ -1,0 +1,90 @@
+"""Main-grad mixed precision (reference: python/paddle/distributed/fleet/
+utils/mix_precision_utils.py — MixPrecisionLayer / MixPrecisionOptimizer,
+SURVEY.md C19 "bf16 main-grad pattern").
+
+The pattern: parameters live in bf16 (halving weight HBM + cast traffic),
+every backward accumulates gradients into an fp32 ``main_grad`` via a
+registered hook, and the optimizer steps on main_grad against fp32 master
+weights (the base Optimizer's ``multi_precision``)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework import dtype as dtypes
+from ....framework.tensor import Tensor
+
+__all__ = ["MixPrecisionLayer", "MixPrecisionOptimizer"]
+
+
+class MixPrecisionLayer:
+    """Wraps a Layer: casts parameter storage to ``dtype`` and installs
+    main-grad hooks (reference: MixPrecisionLayer(layers, dtype="float16"))."""
+
+    def __init__(self, layers, dtype: str = "bfloat16"):
+        self._layers = layers
+        target = dtypes.convert_dtype(dtype)
+        for _, p in layers.named_parameters():
+            if dtypes.is_floating_point(p.dtype):
+                p._data = p._data.astype(target)
+                p.main_grad = None
+
+                def hook(grad, p=p):
+                    g32 = grad._data.astype(jnp.float32)
+                    if p.main_grad is None:
+                        p.main_grad = Tensor._wrap(g32, stop_gradient=True)
+                    else:
+                        p.main_grad = Tensor._wrap(
+                            p.main_grad._data + g32, stop_gradient=True)
+                    # zero the low-precision grad so the bf16 accumulator
+                    # never carries state between hooks (reference clears
+                    # param.grad after folding into main_grad)
+                    return Tensor._wrap(jnp.zeros_like(grad._data),
+                                        stop_gradient=True)
+
+                p.register_hook(hook)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_layers"], item)
+
+
+class MixPrecisionOptimizer:
+    """Wraps an optimizer to consume fp32 ``main_grad`` (reference:
+    MixPrecisionOptimizer). The inner optimizer's ``multi_precision`` master
+    weights provide the fp32 update state."""
+
+    def __init__(self, optimizer):
+        self._inner_opt = optimizer
+
+    def step(self):
+        params = self._inner_opt._parameter_list()
+        saved = []
+        for p in params:
+            mg = getattr(p, "main_grad", None)
+            if mg is not None:
+                saved.append((p, p.grad))
+                p.grad = mg
+        try:
+            self._inner_opt.step()
+        finally:
+            for p, g in saved:
+                p.grad = g
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._inner_opt._parameter_list():
+            if getattr(p, "main_grad", None) is not None:
+                p.main_grad = None
+        self._inner_opt.clear_grad(set_to_zero)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)

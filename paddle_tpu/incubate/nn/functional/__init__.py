@@ -157,3 +157,80 @@ def ring_flash_attention(q, k, v, causal=True, axis_name="sep", **kw):
 
 
 __all__.append("ring_flash_attention")
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """Rotary embedding applied to q/k[/v] in one pass (reference:
+    paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu via
+    incubate.nn.functional.fused_rotary_position_embedding; SURVEY A3.x —
+    "fold into attention"). Layout [batch, seq, heads, head_dim].
+
+    When sin/cos are None they are computed from ``rotary_emb_base``
+    (optionally gathered at ``position_ids``). Returns (q, k, v) with None
+    passed through.
+    """
+    qa = _unwrap(q)
+    if time_major:  # [seq, batch, h, d] — normalize to batch-major
+        s, b = qa.shape[0], qa.shape[1]
+    else:
+        b, s = qa.shape[0], qa.shape[1]
+    d = qa.shape[-1]
+
+    def expand(tab):  # [*, d] table → broadcastable over [b, s, h, d]
+        if tab.ndim == 3:  # per-batch positions [b, s, d]
+            out = tab[:, :, None, :]
+        else:
+            out = tab[None, :, None, :]
+        return jnp.swapaxes(out, 0, 1) if time_major else out
+
+    if sin is None or cos is None:
+        inv = 1.0 / (rotary_emb_base ** (jnp.arange(0, d, 2,
+                                                    dtype=jnp.float32) / d))
+        if position_ids is not None:
+            # compute freqs AT the requested positions (decode steps pass
+            # positions ≥ current seq length — a gathered arange(s) table
+            # would clamp them)
+            pos = _unwrap(position_ids).astype(jnp.float32)  # [b, s]
+            freqs = pos[..., None] * inv  # [b, s, d/2]
+        else:
+            freqs = jnp.outer(jnp.arange(s, dtype=jnp.float32), inv)
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        sin_a, cos_a = expand(jnp.sin(emb)), expand(jnp.cos(emb))
+    else:
+        sin_t = _unwrap(sin).reshape(-1, d)
+        cos_t = _unwrap(cos).reshape(-1, d)
+        if position_ids is not None:
+            pos = _unwrap(position_ids)
+            sin_a, cos_a = expand(sin_t[pos]), expand(cos_t[pos])
+        else:
+            sin_a, cos_a = expand(sin_t[:s]), expand(cos_t[:s])
+
+    def rotate(x):
+        if use_neox_rotary_style:
+            x1, x2 = x[..., : d // 2], x[..., d // 2:]
+            return jnp.concatenate([-x2, x1], axis=-1)
+        x1 = x[..., 0::2]
+        x2 = x[..., 1::2]
+        return jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+
+    def ap(x):
+        c = cos_a.astype(x.dtype)
+        si = sin_a.astype(x.dtype)
+        return x * c + rotate(x) * si
+
+    outs = []
+    for t_in in (q, k, v):
+        if t_in is None:
+            outs.append(None)
+        else:
+            outs.append(apply_op(ap, t_in))
+    return tuple(outs)
+
+
+__all__.append("fused_rotary_position_embedding")

@@ -1,0 +1,51 @@
+"""Main-grad mixed precision tests (SURVEY.md C19)."""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet.utils.mix_precision_utils import (
+    MixPrecisionLayer,
+    MixPrecisionOptimizer,
+)
+
+
+def test_main_grad_accumulation_and_training(rng):
+    net = nn.Linear(8, 1)
+    wrapped = MixPrecisionLayer(net, dtype="bfloat16")
+    for _, p in net.named_parameters():
+        assert str(p.dtype) in ("bfloat16",), p.dtype
+
+    opt = MixPrecisionOptimizer(
+        optimizer.AdamW(learning_rate=0.05, parameters=net.parameters(),
+                        multi_precision=True))
+
+    X = jnp.asarray(rng.standard_normal((64, 8)), jnp.float32)
+    W = jnp.asarray(rng.standard_normal((8, 1)), jnp.float32)
+    Y = X @ W
+    losses = []
+    for i in range(30):
+        pred = wrapped(paddle.to_tensor(X.astype(jnp.bfloat16)))
+        loss = ((pred.astype("float32") - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        # main_grad exists and is fp32
+        p0 = net.parameters()[0]
+        assert p0.main_grad is not None
+        assert str(p0.main_grad.dtype) == "float32"
+        opt.step()
+        opt.clear_grad()
+        assert p0.main_grad is None
+        losses.append(float(loss._data))
+    assert losses[-1] < 0.2 * losses[0], losses
+
+
+def test_main_grad_accumulates_over_microbatches(rng):
+    net = nn.Linear(4, 1)
+    MixPrecisionLayer(net, dtype="bfloat16")
+    x = paddle.to_tensor(jnp.ones((2, 4), jnp.bfloat16))
+    (net(x).sum()).backward()
+    p = net.parameters()[0]
+    g1 = np.asarray(p.main_grad._data).copy()
+    (net(x).sum()).backward()
+    g2 = np.asarray(p.main_grad._data)
+    np.testing.assert_allclose(g2, 2 * g1, rtol=1e-6)

@@ -57,40 +57,45 @@ def main():
 
     model = GPTForCausalLM(cfg)
     model.eval()  # dropout off; loss path is what we time
-    params = param_arrays(model)
+    master = param_arrays(model)  # fp32 master weights (O2 recipe)
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), master)
 
     def loss_fn(params_bf16, ids, labels):
         logits = functional_call(model, params_bf16, Tensor._wrap(ids))
-        logits = logits.astype(jnp.float32)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        # CE on bf16 logits with f32 reductions: skips materializing the
+        # [B,S,V] f32 logits tensor (measured win on v5e)
+        logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None], axis=-1)[..., 0].astype(jnp.float32)
         return jnp.mean(logz - gold)
 
-    @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_m, ids, labels):
-        p_bf16 = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), params)
-        loss, grads = jax.value_and_grad(loss_fn)(p_bf16, ids, labels)
+    @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+    def train_step(params, master, opt_m, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
         grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         new_m = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g, opt_m, grads)
-        new_p = jax.tree_util.tree_map(lambda p, m: p - 1e-4 * m, params, new_m)
-        return new_p, new_m, loss
+        new_master = jax.tree_util.tree_map(lambda p, m: p - 1e-4 * m,
+                                            master, new_m)
+        new_p = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16),
+                                       new_master)
+        return new_p, new_master, new_m, loss
 
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
     labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
-    opt_m = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), params)
+    opt_m = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), master)
 
     # warmup (compile + first dispatch); device_get is the only reliable
     # completion fence on the tunneled TPU backend in this image
     # (block_until_ready can return before execution finishes there).
-    params, opt_m, loss = train_step(params, opt_m, ids, labels)
+    params, master, opt_m, loss = train_step(params, master, opt_m, ids, labels)
     float(jax.device_get(loss))
 
     # Chained dispatch: steps serialize on-device via the params dependency;
     # the final fetch waits for the whole chain. One tunnel round-trip total.
     t0 = time.perf_counter()
     for _ in range(steps):
-        params, opt_m, loss = train_step(params, opt_m, ids, labels)
+        params, master, opt_m, loss = train_step(params, master, opt_m, ids, labels)
     final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 

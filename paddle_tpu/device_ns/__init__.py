@@ -154,9 +154,11 @@ cuda = _CudaNS()
 
 
 def _mem_stats(device_id=0):
-    if isinstance(device_id, str):  # paddle-style "tpu:1" / "gpu:0"
-        device_id = int(device_id.rsplit(":", 1)[-1]) if ":" in device_id \
-            else int(device_id)
+    if isinstance(device_id, str):  # "tpu:1" / "gpu:0" / bare "tpu" (dev 0)
+        if ":" in device_id:
+            device_id = int(device_id.rsplit(":", 1)[-1])
+        else:
+            device_id = int(device_id) if device_id.isdigit() else 0
     elif not isinstance(device_id, int):
         device_id = int(getattr(device_id, "id", device_id))
     d = jax.devices()[device_id]

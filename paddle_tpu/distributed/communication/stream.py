@@ -31,7 +31,6 @@ class Task:
 
 def _wrap(fn):
     def op(*args, sync_op=True, use_calc_stream=False, **kwargs):
-        kwargs.pop("sync_op", None)
         fn(*args, **kwargs)
         return Task()
 

@@ -798,7 +798,7 @@ class Parameter(Tensor):
     layers (SURVEY.md §2 group C)."""
 
     __slots__ = ("optimize_attr", "regularizer", "is_distributed", "dist_spec",
-                 "sequence_parallel", "main_grad", "is_bias")
+                 "sequence_parallel", "main_grad", "is_bias", "is_expert")
 
     def __init__(self, data, dtype=None, name=None, trainable=True):
         super().__init__(data, dtype=dtype, stop_gradient=not trainable, name=name)

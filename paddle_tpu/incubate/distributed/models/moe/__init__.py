@@ -1,6 +1,7 @@
 """Expert-parallel MoE (reference: python/paddle/incubate/distributed/models/
 moe/)."""
 from .gate import BaseGate, GShardGate, NaiveGate, SwitchGate
+from .grad_clip import ClipGradForMOEByGlobalNorm
 from .moe_layer import (
     MoELayer,
     count_by_gate,
@@ -11,4 +12,5 @@ from .moe_layer import (
 __all__ = [
     "MoELayer", "BaseGate", "NaiveGate", "GShardGate", "SwitchGate",
     "count_by_gate", "limit_by_capacity", "gshard_dispatch",
+    "ClipGradForMOEByGlobalNorm",
 ]

@@ -1,0 +1,43 @@
+"""Expert-aware global-norm clip (reference: python/paddle/incubate/
+distributed/models/moe/grad_clip.py — ClipGradForMOEByGlobalNorm).
+
+Expert parameters exist once per expert-parallel rank in the reference, so
+their squared norms are divided by the moe group size before entering the
+global norm (otherwise each replica would be double-counted). Single-
+controller SPMD holds each expert exactly once, so the correction factor is
+1 unless the caller supplies ``moe_group`` world size explicitly."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .....nn.clip import ClipGradByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]
+
+
+def _is_expert(p) -> bool:
+    return bool(getattr(p, "is_expert", False))
+
+
+class ClipGradForMOEByGlobalNorm(ClipGradByGlobalNorm):
+    def __init__(self, clip_norm=1.0, is_expert_param_func=None,
+                 moe_group=None, group_name="default_moe_group"):
+        super().__init__(clip_norm=clip_norm, group_name=group_name)
+        self.is_expert = is_expert_param_func or _is_expert
+        self.moe_world = getattr(moe_group, "nranks", 1) if moe_group else 1
+
+    def _global_sq_norm(self, params_grads):
+        sq_normal = None
+        sq_expert = 0.0
+        for p, g in params_grads:
+            if g is None:
+                continue
+            s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+            if self.is_expert(p):
+                sq_expert = sq_expert + s
+            else:
+                sq_normal = s if sq_normal is None else sq_normal + s
+        if sq_normal is None and not isinstance(sq_expert, jnp.ndarray):
+            return None
+        return (0.0 if sq_normal is None else sq_normal) + (
+            sq_expert / max(1, self.moe_world))

@@ -234,3 +234,80 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
 
 
 __all__.append("fused_rotary_position_embedding")
+
+
+def fused_softmax_mask(x, mask, scale=1.0):
+    """softmax(scale·x + mask) fused (reference:
+    paddle/fluid/operators/fused/fused_softmax_mask_op.cu). On TPU this is
+    one XLA fusion; kept for API parity — inside attention it lives in the
+    flash kernel."""
+    m = _unwrap(mask)
+    return apply_op(
+        lambda a: jax.nn.softmax(a.astype(jnp.float32) * scale + m,
+                                 axis=-1).astype(a.dtype), x)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    """Causal softmax (reference: fused_softmax_mask_upper_triangle_op.cu):
+    softmax over the last dim with the strict upper triangle masked."""
+    def fn(a):
+        sq, sk = a.shape[-2], a.shape[-1]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), sk - sq)
+        s = jnp.where(causal, a.astype(jnp.float32), -jnp.inf)
+        return jax.nn.softmax(s, axis=-1).astype(a.dtype)
+
+    return apply_op(fn, x)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      seed=None, name=None):
+    """dropout(x) + y in one pass (reference:
+    paddle/phi/kernels/fusion/gpu/fused_dropout_add_kernel.cu — the saved
+    seed/offset for exact backward replay is the PRNG key here, which the
+    trace replays bit-exactly by construction)."""
+    from ....nn import functional as F
+
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear_activation(x, weight, bias=None, trans_x=False,
+                            trans_y=False, activation="gelu"):
+    """GEMM + bias + activation epilogue (reference: fused_gemm_epilogue_op
+    via cublasLt; XLA fuses the epilogue into the matmul on TPU)."""
+    from ....nn import functional as F
+
+    xa = x if not trans_x else x.transpose(
+        list(range(x.ndim - 2)) + [x.ndim - 1, x.ndim - 2])
+    wa = weight if not trans_y else weight.transpose(
+        list(range(weight.ndim - 2)) + [weight.ndim - 1, weight.ndim - 2])
+    out = xa.matmul(wa)
+    if bias is not None:
+        out = out + bias
+    act = {"gelu": lambda a: F.gelu(a, approximate=True), "relu": F.relu,
+           "none": lambda a: a, None: lambda a: a}[activation]
+    return act(out)
+
+
+fused_gemm_epilogue = fused_linear_activation  # reference op name
+
+
+def fused_bias_dropout_residual_layer_norm(x, residual, bias=None,
+                                           ln_scale=None, ln_bias=None,
+                                           dropout_rate=0.5, ln_epsilon=1e-5,
+                                           training=True,
+                                           mode="upscale_in_train",
+                                           name=None):
+    """(x + bias) → dropout → + residual → LayerNorm (reference:
+    fused_bias_dropout_residual_layer_norm_kernel.cu)."""
+    from ....nn import functional as F
+
+    h = x if bias is None else x + bias
+    h = F.dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + residual
+    d = h.shape[-1]
+    return F.layer_norm(h, [d], ln_scale, ln_bias, ln_epsilon)
+
+
+__all__ += ["fused_softmax_mask", "fused_softmax_mask_upper_triangle",
+            "fused_dropout_add", "fused_linear_activation",
+            "fused_gemm_epilogue", "fused_bias_dropout_residual_layer_norm"]

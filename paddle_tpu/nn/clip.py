@@ -24,24 +24,33 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def __init__(self, clip_norm=1.0, group_name="default_group", auto_skip_clip=False):
         self.clip_norm = float(clip_norm)
 
-    def __call__(self, params_grads):
+    def _global_sq_norm(self, params_grads):
+        """Σ‖g‖² (overridden by variants, e.g. the MoE expert-aware clip)."""
         sq = None
         for _, g in params_grads:
             if g is None:
                 continue
             s = jnp.sum(jnp.square(g._data.astype(jnp.float32)))
             sq = s if sq is None else sq + s
-        if sq is None:
-            return params_grads
-        global_norm = jnp.sqrt(sq)
-        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-6), 1.0)
+        return sq
+
+    def _apply_scale(self, params_grads, global_norm):
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(global_norm, 1e-6),
+                            1.0)
         out = []
         for p, g in params_grads:
             if g is None:
                 out.append((p, g))
             else:
-                out.append((p, Tensor._wrap((g._data.astype(jnp.float32) * scale).astype(g.dtype))))
+                out.append((p, Tensor._wrap(
+                    (g._data.astype(jnp.float32) * scale).astype(g.dtype))))
         return out
+
+    def __call__(self, params_grads):
+        sq = self._global_sq_norm(params_grads)
+        if sq is None:
+            return params_grads
+        return self._apply_scale(params_grads, jnp.sqrt(sq))
 
     # functional variant for the compiled trainer
     @staticmethod

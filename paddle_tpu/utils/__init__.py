@@ -33,3 +33,4 @@ def deprecated(update_to="", since="", reason=""):
         return wrapper
 
     return decorator
+from . import cpp_extension  # noqa: F401

@@ -1,0 +1,65 @@
+"""paddle.utils.cpp_extension parity (reference:
+python/paddle/utils/cpp_extension/ — JIT-compile user C++/CUDA ops and
+register them; SURVEY.md A25: "jax.ffi / Pallas custom-kernel registration
+helper").
+
+TPU stance: device kernels are Pallas (see paddle_tpu/ops/pallas/); this
+module covers the HOST-side C++ extension path — compile a shared object
+with the baked toolchain and hand back a ctypes handle (the same machinery
+that builds the native TCPStore). CUDA sources are rejected explicitly.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+__all__ = ["load", "CppExtension", "CUDAExtension"]
+
+
+def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
+         extra_cuda_cflags=None, extra_ldflags=None, extra_include_paths=None,
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """JIT-compile C++ ``sources`` into a shared object and dlopen it.
+    Returns the ctypes.CDLL (callers declare argtypes/restypes, or wrap via
+    jax.ffi for in-graph custom calls)."""
+    if any(str(s).endswith((".cu", ".cuh")) for s in sources):
+        raise ValueError(
+            "CUDA sources are not buildable on TPU — write device kernels "
+            "in Pallas (paddle_tpu/ops/pallas) and host code in C++")
+    import subprocess
+    import sys
+
+    build = build_directory or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(build, exist_ok=True)
+    so = os.path.join(build, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    if not (os.path.exists(so) and all(
+            os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs)):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread"]
+        for inc in (extra_include_paths or []):
+            cmd += ["-I", inc]
+        cmd += (extra_cxx_cflags or [])
+        cmd += ["-o", so, *srcs]
+        cmd += (extra_ldflags or [])
+        if verbose:
+            print(" ".join(cmd), file=sys.stderr)
+        r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if r.returncode != 0:
+            raise RuntimeError(f"cpp_extension build failed:\n{r.stderr}")
+    return ctypes.CDLL(so)
+
+
+class CppExtension:
+    """setup()-style descriptor parity (reference CppExtension)."""
+
+    def __init__(self, sources, *args, **kwargs):
+        self.sources = list(sources)
+        self.kwargs = kwargs
+
+
+def CUDAExtension(*args, **kwargs):  # pragma: no cover
+    raise NotImplementedError(
+        "CUDAExtension is CUDA-only; on TPU write Pallas kernels "
+        "(paddle_tpu/ops/pallas) or host C++ via CppExtension/load")

@@ -128,3 +128,93 @@ def test_rope_time_major(rng):
     np.testing.assert_allclose(np.asarray(out_tm._data),
                                np.swapaxes(np.asarray(out_bm._data), 0, 1),
                                atol=1e-5)
+
+
+class TestFusedFunctionalParity:
+    def test_fused_softmax_masks(self, rng):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_softmax_mask,
+            fused_softmax_mask_upper_triangle,
+        )
+
+        x = jnp.asarray(rng.standard_normal((2, 2, 4, 4)), jnp.float32)
+        mask = jnp.where(jnp.arange(4) < 3, 0.0, -1e9)[None, None, None, :]
+        out = fused_softmax_mask(paddle.to_tensor(x), mask)
+        ref = jax.nn.softmax(x + mask, axis=-1)
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   atol=1e-6)
+
+        out2 = fused_softmax_mask_upper_triangle(paddle.to_tensor(x))
+        tri = jnp.where(jnp.tril(jnp.ones((4, 4), bool)), x, -jnp.inf)
+        np.testing.assert_allclose(np.asarray(out2._data),
+                                   np.asarray(jax.nn.softmax(tri, -1)),
+                                   atol=1e-6)
+
+    def test_fused_gemm_epilogue(self, rng):
+        from paddle_tpu.incubate.nn.functional import fused_gemm_epilogue
+
+        x = paddle.to_tensor(jnp.asarray(rng.standard_normal((4, 8)),
+                                         jnp.float32))
+        w = paddle.to_tensor(jnp.asarray(rng.standard_normal((8, 6)),
+                                         jnp.float32))
+        b = paddle.to_tensor(jnp.zeros((6,), jnp.float32))
+        out = fused_gemm_epilogue(x, w, b, activation="relu")
+        ref = np.maximum(np.asarray(x._data) @ np.asarray(w._data), 0)
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5)
+
+    def test_fused_bias_dropout_residual_ln(self, rng):
+        from paddle_tpu.incubate.nn.functional import (
+            fused_bias_dropout_residual_layer_norm,
+        )
+
+        x = paddle.to_tensor(jnp.asarray(rng.standard_normal((2, 8)),
+                                         jnp.float32))
+        r = paddle.to_tensor(jnp.asarray(rng.standard_normal((2, 8)),
+                                         jnp.float32))
+        out = fused_bias_dropout_residual_layer_norm(
+            x, r, dropout_rate=0.0, training=False)
+        h = np.asarray(x._data) + np.asarray(r._data)
+        mu = h.mean(-1, keepdims=True)
+        ref = (h - mu) / np.sqrt(h.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-5)
+
+    def test_moe_grad_clip(self, rng):
+        from paddle_tpu.incubate.distributed.models.moe import (
+            ClipGradForMOEByGlobalNorm,
+        )
+        from paddle_tpu.framework.tensor import Parameter, Tensor
+
+        p1 = Parameter(jnp.ones((4,)))
+        p2 = Parameter(jnp.ones((4,)))
+        p2.is_expert = True
+        g = Tensor._wrap(jnp.full((4,), 10.0))
+        clip = ClipGradForMOEByGlobalNorm(clip_norm=1.0)
+        out = clip([(p1, g), (p2, g)])
+        total = np.sqrt(sum(
+            float(jnp.sum(gg._data ** 2)) for _, gg in out))
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+    def test_cpp_extension_load(self, tmp_path):
+        from paddle_tpu.utils import cpp_extension
+
+        src = tmp_path / "addmul.cc"
+        src.write_text("""
+        extern "C" double addmul(double a, double b, double c) {
+            return a + b * c;
+        }
+        """)
+        lib = cpp_extension.load("addmul", [str(src)],
+                                 build_directory=str(tmp_path / "b"))
+        import ctypes
+
+        lib.addmul.restype = ctypes.c_double
+        lib.addmul.argtypes = [ctypes.c_double] * 3
+        assert lib.addmul(1.0, 2.0, 3.0) == 7.0
+
+    def test_cuda_sources_rejected(self, tmp_path):
+        import pytest as _pytest
+
+        from paddle_tpu.utils import cpp_extension
+
+        with _pytest.raises(ValueError, match="Pallas"):
+            cpp_extension.load("x", ["kernel.cu"])

@@ -133,3 +133,9 @@ def summary(net, input_size=None, dtypes=None):
     n_params = sum(p.size for p in net.parameters())
     trainable = sum(p.size for p in net.parameters() if p.trainable)
     return {"total_params": n_params, "trainable_params": trainable}
+
+
+# top-level aliases resolved from submodules (paddle exports these at root)
+from .ops.linalg import cross, histogram, norm  # noqa: F401,E402
+from .nn.functional.activation import log_softmax  # noqa: F401,E402
+from .ops.math import bincount, einsum, nonzero, unique  # noqa: F401,E402

@@ -37,7 +37,12 @@ class HybridParallelOptimizer:
         while hasattr(inner, "_inner"):
             inner = inner._inner
         clip = getattr(inner, "_grad_clip", None)
-        if isinstance(clip, ClipGradByGlobalNorm):
+        # Swap ONLY the exact base class: subclasses that override the norm
+        # (e.g. the MoE expert-aware clip) own their computation — wrapping
+        # them would silently drop the override. Under single-controller
+        # SPMD their norms are already global; the hybrid swap matters for
+        # the eager multi-process path only.
+        if type(clip) is ClipGradByGlobalNorm:
             inner._grad_clip = HybridParallelClipGrad(clip, hcg)
 
     def _sync_replicated_grads(self):

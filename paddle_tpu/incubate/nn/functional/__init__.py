@@ -283,9 +283,13 @@ def fused_linear_activation(x, weight, bias=None, trans_x=False,
     out = xa.matmul(wa)
     if bias is not None:
         out = out + bias
-    act = {"gelu": lambda a: F.gelu(a, approximate=True), "relu": F.relu,
-           "none": lambda a: a, None: lambda a: a}[activation]
-    return act(out)
+    acts = {"gelu": lambda a: F.gelu(a, approximate=True), "relu": F.relu,
+            "none": lambda a: a, None: lambda a: a}
+    if activation not in acts:
+        raise ValueError(
+            f"fused_linear_activation: unsupported activation "
+            f"{activation!r}; choose from {sorted(k for k in acts if k)}")
+    return acts[activation](out)
 
 
 fused_gemm_epilogue = fused_linear_activation  # reference op name

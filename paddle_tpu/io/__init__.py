@@ -25,3 +25,4 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .buffered_reader import BufferedReader  # noqa: F401

@@ -1,0 +1,134 @@
+"""Buffered reader over the native ring buffer (reference:
+paddle/fluid/operators/reader/buffered_reader.cc — the C++ prefetch
+double-buffer; SURVEY.md B6 "worker-pool design feeding jax.device_put with
+double-buffering").
+
+``BufferedReader(iterable)`` runs the source on a producer thread and hands
+numpy-batch payloads through the native C++ ring (memcpy outside the GIL);
+without a toolchain it degrades to a queue.Queue with identical semantics.
+"""
+from __future__ import annotations
+
+import ctypes
+import pickle
+import queue
+import threading
+from typing import Iterable, Iterator, Optional
+
+__all__ = ["BufferedReader"]
+
+_SENTINEL_ERR = b"\x01"
+_PAYLOAD = b"\x00"
+
+
+def _ring_lib():
+    from ..native import load
+
+    lib = load("ring_buffer", ["ring_buffer.cc"])
+    if lib is None:
+        return None
+    lib.rb_create.restype = ctypes.c_void_p
+    lib.rb_create.argtypes = [ctypes.c_uint64, ctypes.c_uint64]
+    lib.rb_push.restype = ctypes.c_int64
+    lib.rb_push.argtypes = [ctypes.c_void_p,
+                            ctypes.POINTER(ctypes.c_uint8),
+                            ctypes.c_uint64, ctypes.c_int64]
+    lib.rb_pop.restype = ctypes.c_int64
+    lib.rb_pop.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_uint8),
+                           ctypes.c_uint64, ctypes.c_int64]
+    lib.rb_peek_len.restype = ctypes.c_int64
+    lib.rb_peek_len.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rb_close.argtypes = [ctypes.c_void_p]
+    lib.rb_destroy.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class BufferedReader:
+    """Iterate ``source`` with ``capacity`` batches of lookahead."""
+
+    def __init__(self, source: Iterable, capacity: int = 2,
+                 use_native: Optional[bool] = None,
+                 slot_bytes: int = 1 << 20):
+        self._source = source
+        self._capacity = max(1, int(capacity))
+        lib = None
+        if use_native is not False:
+            lib = _ring_lib()
+            if lib is None and use_native is True:
+                raise RuntimeError("native ring_buffer unavailable")
+        self._lib = lib
+        self.backend = "native" if lib is not None else "python"
+
+    # ---------------------------------------------------------------- iter
+    def __iter__(self) -> Iterator:
+        if self._lib is None:
+            return self._iter_python()
+        return self._iter_native()
+
+    def _iter_python(self):
+        q: "queue.Queue" = queue.Queue(maxsize=self._capacity)
+        DONE = object()
+
+        def produce():
+            try:
+                for item in self._source:
+                    q.put(item)
+                q.put(DONE)
+            except BaseException as e:  # surfaced on the consumer side
+                q.put(e)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="buffered-reader")
+        t.start()
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+
+    def _iter_native(self):
+        lib = self._lib
+        h = lib.rb_create(1 << 20, self._capacity)
+        if not h:
+            yield from self._iter_python()
+            return
+
+        def produce():
+            try:
+                for item in self._source:
+                    payload = _PAYLOAD + pickle.dumps(
+                        item, protocol=pickle.HIGHEST_PROTOCOL)
+                    buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(
+                        payload)
+                    lib.rb_push(h, buf, len(payload), -1)
+            except BaseException as e:
+                payload = _SENTINEL_ERR + pickle.dumps(e)
+                buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(
+                    payload)
+                lib.rb_push(h, buf, len(payload), -1)
+            finally:
+                lib.rb_close(h)
+
+        t = threading.Thread(target=produce, daemon=True,
+                             name="buffered-reader-native")
+        t.start()
+        try:
+            while True:
+                n = lib.rb_peek_len(h, -1)
+                if n == -2:  # closed + drained
+                    return
+                out = (ctypes.c_uint8 * max(int(n), 1))()
+                got = lib.rb_pop(h, out, len(out), -1)
+                if got == -2:
+                    return
+                raw = bytes(out[:got])
+                if raw[:1] == _SENTINEL_ERR:
+                    raise pickle.loads(raw[1:])
+                yield pickle.loads(raw[1:])
+        finally:
+            lib.rb_close(h)
+            t.join(timeout=5)
+            lib.rb_destroy(h)

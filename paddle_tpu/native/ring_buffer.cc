@@ -1,0 +1,112 @@
+// Byte-slot ring buffer — native core of the buffered reader.
+//
+// Reference parity: paddle/fluid/operators/reader/buffered_reader.cc — the
+// C++ double-buffer between dataset workers and the device feed. Here it is
+// a bounded MPSC ring of byte slots with mutex+condvar blocking on both
+// ends; the memcpy of batch payloads happens inside these C calls, i.e.
+// OUTSIDE the Python GIL (ctypes releases it for the duration of the call),
+// so producer and consumer copy concurrently with Python-level work.
+//
+// C ABI (ctypes):
+//   rb_create(slot_bytes, n_slots) -> handle
+//   rb_push(h, data, len, timeout_ms) -> 0 | -1 timeout | -2 closed | -3 too big
+//   rb_pop(h, out, cap, timeout_ms)  -> len | -1 timeout | -2 closed+empty | -3 cap
+//   rb_close(h)    (producer side: consumers drain then see -2)
+//   rb_destroy(h)
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Ring {
+  std::vector<std::vector<uint8_t>> slots;
+  std::vector<uint32_t> sizes;
+  size_t head = 0, tail = 0, count = 0;
+  bool closed = false;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+
+  explicit Ring(size_t slot_bytes, size_t n) : slots(n), sizes(n, 0) {
+    for (auto& s : slots) s.reserve(slot_bytes);
+  }
+};
+
+template <typename Pred>
+bool wait_on(std::condition_variable& cv, std::unique_lock<std::mutex>& lk,
+             int64_t timeout_ms, Pred pred) {
+  if (timeout_ms < 0) {
+    cv.wait(lk, pred);
+    return true;
+  }
+  return cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), pred);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rb_create(uint64_t slot_bytes, uint64_t n_slots) {
+  if (n_slots == 0) return nullptr;
+  return new Ring(slot_bytes, n_slots);
+}
+
+int64_t rb_push(void* h, const uint8_t* data, uint64_t len,
+                int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  if (!wait_on(r->not_full, lk, timeout_ms,
+               [&] { return r->count < r->slots.size() || r->closed; }))
+    return -1;
+  if (r->closed) return -2;
+  auto& slot = r->slots[r->tail];
+  slot.resize(len);
+  if (len) std::memcpy(slot.data(), data, len);
+  r->sizes[r->tail] = static_cast<uint32_t>(len);
+  r->tail = (r->tail + 1) % r->slots.size();
+  ++r->count;
+  r->not_empty.notify_one();
+  return 0;
+}
+
+int64_t rb_pop(void* h, uint8_t* out, uint64_t cap, int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  if (!wait_on(r->not_empty, lk, timeout_ms,
+               [&] { return r->count > 0 || r->closed; }))
+    return -1;
+  if (r->count == 0) return -2;  // closed and drained
+  uint32_t len = r->sizes[r->head];
+  if (len > cap) return -3;
+  if (len) std::memcpy(out, r->slots[r->head].data(), len);
+  r->head = (r->head + 1) % r->slots.size();
+  --r->count;
+  r->not_full.notify_one();
+  return static_cast<int64_t>(len);
+}
+
+int64_t rb_peek_len(void* h, int64_t timeout_ms) {
+  auto* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  if (!wait_on(r->not_empty, lk, timeout_ms,
+               [&] { return r->count > 0 || r->closed; }))
+    return -1;
+  if (r->count == 0) return -2;
+  return static_cast<int64_t>(r->sizes[r->head]);
+}
+
+void rb_close(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  r->closed = true;
+  r->not_empty.notify_all();
+  r->not_full.notify_all();
+}
+
+void rb_destroy(void* h) { delete static_cast<Ring*>(h); }
+
+}  // extern "C"

@@ -328,3 +328,58 @@ def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
 
 def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False):
     return Tensor._wrap(jnp.isclose(_t(x)._data, _t(y)._data, rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def einsum(equation, *operands):
+    """paddle.einsum parity (reference: python/paddle/tensor/einsum.py)."""
+    return apply_op(lambda *a: jnp.einsum(equation, *a), *operands)
+
+
+def nonzero(x, as_tuple=False):
+    """Indices of nonzero elements. NOTE: data-dependent output shape —
+    eager-only (the reference's static-graph version pads; under jit use
+    jnp.where with a size argument)."""
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    import numpy as _np
+
+    idx = _np.nonzero(_np.asarray(a))
+    if as_tuple:
+        return tuple(Tensor._wrap(jnp.asarray(i)) for i in idx)
+    return Tensor._wrap(jnp.asarray(_np.stack(idx, axis=1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64"):
+    """paddle.unique parity (eager-only: data-dependent shape)."""
+    import numpy as _np
+
+    a = _np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = _np.unique(a, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor._wrap(jnp.asarray(res))
+    res = list(res)
+    if return_inverse:
+        # paddle returns a FLAT 1-D inverse; numpy ≥2.0 shapes it like the
+        # input — normalize so ported code indexes consistently
+        inv_pos = 1 + int(return_index)
+        if axis is None:
+            res[inv_pos] = res[inv_pos].reshape(-1)
+    return tuple(Tensor._wrap(jnp.asarray(r)) for r in res)
+
+
+def bincount(x, weights=None, minlength=0):
+    a = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    w = weights._data if isinstance(weights, Tensor) else weights
+    # NB: the module-level max() shadows the builtin here
+    if not a.size:
+        return Tensor._wrap(jnp.zeros((minlength,), jnp.int64
+                                      if w is None else jnp.asarray(w).dtype))
+    hi = int(jnp.max(a)) + 1
+    length = hi if hi > minlength else minlength
+    return Tensor._wrap(jnp.bincount(a, w, minlength=minlength,
+                                     length=length))
+
+
+__all__ += ["einsum", "nonzero", "unique", "bincount"]

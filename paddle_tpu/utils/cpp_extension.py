@@ -33,8 +33,15 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
     build = build_directory or os.path.join(
         os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
     os.makedirs(build, exist_ok=True)
-    so = os.path.join(build, f"lib{name}.so")
     srcs = [os.path.abspath(s) for s in sources]
+    # cache key covers the FULL build configuration, not just the name —
+    # same-name loads with different sources/flags must not collide
+    import hashlib
+
+    cfg = repr((sorted(srcs), extra_cxx_cflags, extra_ldflags,
+                extra_include_paths))
+    tag = hashlib.sha1(cfg.encode()).hexdigest()[:10]
+    so = os.path.join(build, f"lib{name}.{tag}.so")
     if not (os.path.exists(so) and all(
             os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs)):
         cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread"]

@@ -1,0 +1,55 @@
+"""Native ring-buffer buffered reader tests (reference parity:
+buffered_reader.cc; SURVEY.md B6)."""
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import BufferedReader
+from paddle_tpu.io.buffered_reader import _ring_lib
+
+HAS_NATIVE = _ring_lib() is not None
+
+
+@pytest.mark.parametrize("native", [False] + ([True] if HAS_NATIVE else []))
+class TestBufferedReader:
+    def test_order_and_contents(self, native, rng):
+        batches = [rng.standard_normal((4, 8)).astype(np.float32)
+                   for _ in range(10)]
+        got = list(BufferedReader(iter(batches), capacity=3,
+                                  use_native=native))
+        assert len(got) == 10
+        for a, b in zip(batches, got):
+            np.testing.assert_array_equal(a, b)
+
+    def test_producer_exception_propagates(self, native):
+        def gen():
+            yield 1
+            raise ValueError("boom")
+
+        reader = BufferedReader(gen(), use_native=native)
+        it = iter(reader)
+        assert next(it) == 1
+        with pytest.raises(ValueError, match="boom"):
+            next(it)
+
+    def test_lookahead_overlaps_producer(self, native):
+        """Consumer stalls must not block an already-buffered producer."""
+        times = []
+
+        def gen():
+            for i in range(4):
+                times.append(time.monotonic())
+                yield i
+
+        reader = BufferedReader(gen(), capacity=4, use_native=native)
+        it = iter(reader)
+        first = next(it)
+        time.sleep(0.3)  # producer should have finished during this stall
+        rest = list(it)
+        assert [first] + rest == [0, 1, 2, 3]
+        assert max(times) - min(times) < 0.25
+
+
+def test_native_builds():
+    assert HAS_NATIVE, "ring_buffer.cc failed to compile"

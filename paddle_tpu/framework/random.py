@@ -13,15 +13,20 @@ import threading
 import jax
 
 _lock = threading.Lock()
-_state = {"seed": 0, "counter": 0, "key": jax.random.key(0)}
+# key is LAZY: creating it would initialize the XLA backend, and importing
+# paddle_tpu must stay legal before jax.distributed.initialize() on
+# multi-host (initialize() refuses to run after backend init)
+_state = {"seed": 0, "counter": 0, "key": None}
 
 
 def seed(s: int):
-    """Set the global seed (paddle.seed parity)."""
+    """Set the global seed (paddle.seed parity). Stays backend-lazy: the key
+    materializes on first use, so seeding BEFORE jax.distributed.initialize
+    (the standard multi-host startup order) is safe."""
     with _lock:
         _state["seed"] = int(s)
         _state["counter"] = 0
-        _state["key"] = jax.random.key(int(s))
+        _state["key"] = None
     return None
 
 
@@ -29,16 +34,23 @@ def get_seed() -> int:
     return _state["seed"]
 
 
+def _ensure_key():
+    if _state["key"] is None:
+        _state["key"] = jax.random.key(_state["seed"])
+    return _state["key"]
+
+
 def next_key():
     """Return a fresh PRNG key (eager use only — not jit-stable)."""
     with _lock:
         _state["counter"] += 1
-        return jax.random.fold_in(_state["key"], _state["counter"])
+        return jax.random.fold_in(_ensure_key(), _state["counter"])
 
 
 def base_key():
     """The base key for deterministic jit-side derivation via fold_in."""
-    return _state["key"]
+    with _lock:
+        return _ensure_key()
 
 
 class _KeyCtx(threading.local):

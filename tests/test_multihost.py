@@ -1,0 +1,74 @@
+"""True multi-process distributed test (SURVEY.md §4.5 item 3: "keep a small
+subprocess suite for true multi-host (jax.distributed over localhost) to
+cover DCN init, launch CLI").
+
+Two REAL processes rendezvous through jax.distributed's coordination service
+(launched by our CLI with the PADDLE_* env contract) and run a cross-host
+psum — the reference's test_dist_base.py pattern, NCCL replaced by the
+coordination service + XLA CPU collectives.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+WORKER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)  # 1 device per process (true multi-host)
+    for _v in list(os.environ):
+        if _v.startswith(("TPU_", "PALLAS_AXON", "AXON_")):
+            del os.environ[_v]
+    sys.path.insert(0, "__REPO__")
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()   # jax.distributed.initialize under the hood
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert dist.get_world_size() == 2
+    devs = jax.devices()
+    mesh = Mesh(devs, ("dp",))
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")),
+        np.full((1, 4), 1.0 + jax.process_index()))
+
+    def f(x):
+        return jax.lax.psum(x, "dp")
+
+    g = jax.shard_map(f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                      axis_names={"dp"}, check_vma=False)
+    out = jax.jit(g)(arr)
+    local = np.asarray(out.addressable_shards[0].data)
+    # psum of per-process values 1.0 and 2.0 over both hosts
+    assert np.allclose(local, 3.0), local
+    print("MULTIHOST_OK", jax.process_index(), flush=True)
+""")
+
+
+@pytest.mark.timeout(240)
+def test_two_process_dcn_bootstrap_and_psum(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER.replace("__REPO__", repo))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS",)}
+    env["PALLAS_AXON_POOL_IPS"] = ""  # keep the axon claim out of children
+    log_dir = tmp_path / "log"
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--log_dir", str(log_dir), str(script)],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=220,
+    )
+    logs = ""
+    for i in (0, 1):
+        p = log_dir / f"workerlog.{i}"
+        if p.exists():
+            logs += f"--- worker {i}\n" + p.read_text()[-2000:]
+    assert r.returncode == 0, f"launch failed\n{r.stderr[-2000:]}\n{logs}"
+    assert "MULTIHOST_OK 0" in logs and "MULTIHOST_OK 1" in logs, logs

@@ -52,6 +52,7 @@ class BufferedReader:
                  slot_bytes: int = 1 << 20):
         self._source = source
         self._capacity = max(1, int(capacity))
+        self._slot_bytes = max(1, int(slot_bytes))
         lib = None
         if use_native is not False:
             lib = _ring_lib()
@@ -91,7 +92,7 @@ class BufferedReader:
 
     def _iter_native(self):
         lib = self._lib
-        h = lib.rb_create(1 << 20, self._capacity)
+        h = lib.rb_create(self._slot_bytes, self._capacity)
         if not h:
             yield from self._iter_python()
             return
@@ -103,7 +104,11 @@ class BufferedReader:
                         item, protocol=pickle.HIGHEST_PROTOCOL)
                     buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(
                         payload)
-                    lib.rb_push(h, buf, len(payload), -1)
+                    if lib.rb_push(h, buf, len(payload), -1) != 0:
+                        # -2: consumer closed the ring (abandoned iteration)
+                        # — stop draining the source promptly so the
+                        # consumer's join() succeeds and the ring is freed
+                        return
             except BaseException as e:
                 payload = _SENTINEL_ERR + pickle.dumps(e)
                 buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(
@@ -131,4 +136,11 @@ class BufferedReader:
         finally:
             lib.rb_close(h)
             t.join(timeout=5)
-            lib.rb_destroy(h)
+            if t.is_alive():
+                # The producer is still blocked inside the source iterator
+                # and may yet call rb_push on this handle; freeing it now
+                # would be a use-after-free in native code. Leak the (small)
+                # ring instead — rb_close already unblocked its next push.
+                pass
+            else:
+                lib.rb_destroy(h)

@@ -22,7 +22,11 @@ _libs = {}
 
 
 def _compile(name: str, sources) -> Optional[str]:
-    """g++ -O2 -shared; returns .so path or None when unavailable."""
+    """g++ -O2 -shared; returns .so path or None when unavailable.
+
+    Compiles to a per-process temp path and os.rename()s into place so
+    sibling processes racing on a cold cache never dlopen a half-written
+    .so (rename is atomic within a filesystem)."""
     so = os.path.join(_BUILD, f"lib{name}.so")
     srcs = [os.path.join(_HERE, s) for s in sources]
     if os.path.exists(so) and all(
@@ -30,8 +34,9 @@ def _compile(name: str, sources) -> Optional[str]:
     ):
         return so
     os.makedirs(_BUILD, exist_ok=True)
+    tmp = f"{so}.tmp.{os.getpid()}"
     cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread",
-           "-o", so, *srcs]
+           "-o", tmp, *srcs]
     try:
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
     except (OSError, subprocess.TimeoutExpired):
@@ -39,17 +44,36 @@ def _compile(name: str, sources) -> Optional[str]:
     if r.returncode != 0:
         print(f"[paddle_tpu.native] build of {name} failed:\n{r.stderr}",
               file=sys.stderr)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return None
+    try:
+        os.rename(tmp, so)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        if not os.path.exists(so):
+            return None
     return so
 
 
 def load(name: str, sources) -> Optional[ctypes.CDLL]:
-    """Build (if needed) + dlopen a native component; None on failure."""
+    """Build (if needed) + dlopen a native component; None on failure
+    (callers engage their pure-Python fallback)."""
     with _lock:
         if name in _libs:
             return _libs[name]
         so = _compile(name, sources)
-        lib = ctypes.CDLL(so) if so else None
+        try:
+            lib = ctypes.CDLL(so) if so else None
+        except OSError as e:
+            print(f"[paddle_tpu.native] dlopen of {name} failed: {e}",
+                  file=sys.stderr)
+            lib = None
         _libs[name] = lib
         return lib
 

@@ -8,8 +8,9 @@
 // so producer and consumer copy concurrently with Python-level work.
 //
 // C ABI (ctypes):
-//   rb_create(slot_bytes, n_slots) -> handle
-//   rb_push(h, data, len, timeout_ms) -> 0 | -1 timeout | -2 closed | -3 too big
+//   rb_create(slot_bytes, n_slots) -> handle   (slot_bytes = reserve hint;
+//                                               slots grow to fit any push)
+//   rb_push(h, data, len, timeout_ms) -> 0 | -1 timeout | -2 closed
 //   rb_pop(h, out, cap, timeout_ms)  -> len | -1 timeout | -2 closed+empty | -3 cap
 //   rb_close(h)    (producer side: consumers drain then see -2)
 //   rb_destroy(h)

@@ -23,6 +23,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -173,6 +174,14 @@ void serve_conn(Server* srv, int fd) {
     uint32_t olen = static_cast<uint32_t>(out.size());
     if (!write_n(fd, &status, 8) || !write_n(fd, &olen, 4)) break;
     if (olen && !write_n(fd, out.data(), olen)) break;
+  }
+  {
+    // Deregister before close: shutdown() replays ::shutdown over
+    // client_fds, and a stale entry could hit an unrelated descriptor the
+    // process has since reused under the same number.
+    std::lock_guard<std::mutex> lk(srv->conn_mu);
+    auto& v = srv->client_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
   }
   ::close(fd);
 }

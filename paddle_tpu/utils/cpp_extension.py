@@ -44,17 +44,33 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
     so = os.path.join(build, f"lib{name}.{tag}.so")
     if not (os.path.exists(so) and all(
             os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs)):
+        # temp + atomic rename: concurrent processes on a cold cache must
+        # never dlopen a partially-written .so
+        tmp = f"{so}.tmp.{os.getpid()}"
         cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17", "-pthread"]
         for inc in (extra_include_paths or []):
             cmd += ["-I", inc]
         cmd += (extra_cxx_cflags or [])
-        cmd += ["-o", so, *srcs]
+        cmd += ["-o", tmp, *srcs]
         cmd += (extra_ldflags or [])
         if verbose:
             print(" ".join(cmd), file=sys.stderr)
         r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
         if r.returncode != 0:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             raise RuntimeError(f"cpp_extension build failed:\n{r.stderr}")
+        try:
+            os.rename(tmp, so)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            if not os.path.exists(so):
+                raise
     return ctypes.CDLL(so)
 
 

@@ -51,5 +51,27 @@ class TestBufferedReader:
         assert max(times) - min(times) < 0.25
 
 
+@pytest.mark.skipif(not HAS_NATIVE, reason="no native ring")
+def test_abandoned_iteration_stops_producer_promptly():
+    """Consumer breaking out of iteration closes the ring; the producer must
+    observe rb_push's closed code and stop draining the source instead of
+    iterating it to exhaustion (which also forced the ring to leak)."""
+    state = {"pulled": 0}
+
+    def source():
+        for i in range(100_000):
+            state["pulled"] = i
+            yield np.zeros(64)
+
+    reader = BufferedReader(source(), capacity=2, use_native=True)
+    t0 = time.time()
+    for _ in reader:
+        break
+    # producer gets at most capacity + a couple in-flight items ahead
+    time.sleep(0.5)
+    assert state["pulled"] < 50, state["pulled"]
+    assert time.time() - t0 < 6  # never hit the 5s join timeout
+
+
 def test_native_builds():
     assert HAS_NATIVE, "ring_buffer.cc failed to compile"

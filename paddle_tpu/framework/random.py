@@ -84,6 +84,23 @@ class key_context:
         return False
 
 
+def derived_context(*indices):
+    """A :class:`key_context` folding ``indices`` (python ints or traced
+    scalars — e.g. ``lax.scan`` iteration index, ``lax.axis_index``) into the
+    current context key.
+
+    ``lax.scan``/``shard_map`` bodies are traced ONCE, so a per-trace site
+    counter alone hands every scan iteration and every manual-axis shard the
+    SAME key; wrapping the body in ``derived_context(k, t, stage)`` makes
+    dropout masks independent across layers, microbatch ticks, and pipeline
+    stages while staying deterministic per step.
+    """
+    base = _key_ctx.stack[-1][0] if _key_ctx.stack else _ensure_key()
+    for ix in indices:
+        base = jax.random.fold_in(base, ix)
+    return key_context(base)
+
+
 def op_key():
     """Key for one random op: context-derived when tracing, global otherwise."""
     if _key_ctx.stack:

@@ -1,0 +1,115 @@
+"""Dropout key decorrelation in compiled/scanned code.
+
+Round-1 advisor finding: lax.scan bodies and shard_map stages trace once, so
+key_context's per-trace site counter handed every layer, microbatch tick, and
+pipeline stage the SAME dropout mask. ``derived_context`` folds the scan and
+axis indices into the key; these tests pin the decorrelation down at both the
+primitive and the pipeline-engine level.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer, PipelineParallel,
+)
+from paddle_tpu.framework import random as _random
+from paddle_tpu.framework.tensor import Tensor
+
+H = 16
+
+
+def test_derived_context_decorrelates_scan():
+    base = jax.random.key(0)
+
+    def body(c, k):
+        with _random.derived_context(k):
+            bits = jax.random.bernoulli(_random.op_key(), 0.5, (32,))
+        return c, bits
+
+    with _random.key_context(base):
+        _, masks = jax.lax.scan(body, 0, jnp.arange(4))
+    masks = np.asarray(masks)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not np.array_equal(masks[i], masks[j])
+
+
+def test_derived_context_deterministic():
+    base = jax.random.key(7)
+    with _random.key_context(base):
+        with _random.derived_context(3):
+            a = jax.random.normal(_random.op_key(), (8,))
+    with _random.key_context(base):
+        with _random.derived_context(3):
+            b = jax.random.normal(_random.op_key(), (8,))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class MaskBlock(nn.Layer):
+    """Emits x + dropout-mask-of-ones: stacking blocks sums the masks, making
+    per-layer/stage/tick masks observable at the pipeline output."""
+
+    def __init__(self):
+        super().__init__()
+        # a parameter so the stage has trainable state (engine requires none,
+        # but keeps the stacked-state path realistic)
+        from paddle_tpu.nn import initializer as I
+        self.scale = self.create_parameter(
+            [1], default_initializer=I.Constant(1.0))
+        self.drop = nn.Dropout(0.5)
+
+    def forward(self, x):
+        ones = Tensor._wrap(jnp.ones_like(
+            x._data if isinstance(x, Tensor) else x))
+        return x + self.drop(ones) * self.scale
+
+
+@pytest.fixture
+def fleet_pp2():
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 2, "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    return strategy
+
+
+def test_pipeline_dropout_decorrelated(fleet_pp2):
+    # pp=2, K=2 layers/stage, M=4 microbatches of zeros: output rows are pure
+    # sums of 4 masks (one per layer crossing).  Each mask element is 0 or 2
+    # (p=0.5 scaling), so sums live in {0,2,4,6,8}.
+    model = PipelineLayer(layers=[LayerDesc(MaskBlock) for _ in range(4)],
+                          num_stages=2)
+    eng = PipelineParallel(model, hcg=fleet.get_hybrid_communicate_group(),
+                           strategy=fleet_pp2)
+    eng._build_state()
+    x = jnp.zeros((8, H), jnp.float32)
+
+    @jax.jit
+    def fwd(state, x_in):
+        with _random.key_context(
+            jax.random.fold_in(_random.base_key(), 11)
+        ):
+            out = eng._pipeline_fwd(state, x_in, micro=4, training=True)
+        return out._data if isinstance(out, Tensor) else out
+
+    o = np.asarray(fwd(eng._state, x))
+
+    # tick decorrelation: different microbatches (identical zero inputs) must
+    # receive different masks — pre-fix they were elementwise equal
+    mb = o.reshape(4, 2, H)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not np.array_equal(mb[i], mb[j]), (i, j)
+
+    # layer/stage decorrelation: if the two layers in a stage (or the two
+    # stages) shared masks, every element would be an even multiple of 2
+    # ({0,4,8}); odd multiples prove independent per-layer masks
+    vals = np.unique(np.round(o).astype(int))
+    assert set(vals) <= {0, 2, 4, 6, 8}, vals
+    assert (2 in vals) or (6 in vals), vals

@@ -1,0 +1,129 @@
+"""1F1B schedule: memory advantage over GPipe (reference:
+pipeline_parallel.py forward_backward_pipeline — 1F1B exists to cap in-flight
+activations at O(pp) instead of O(M)).
+
+Twin-equality of the two schedules is covered in test_pipeline_parallel.py;
+here we pin the MEMORY claim with XLA's compile-time memory analysis: at
+M=8 microbatches the 1F1B step's temp allocation must be strictly below
+GPipe's for the same model/config.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer,
+)
+from paddle_tpu.framework.tensor import Tensor
+
+H = 64
+VOCAB = 256
+SEQ = 32
+M = 8
+
+
+class EmbedPipe(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.word = nn.Embedding(VOCAB, H)
+
+    def forward(self, x):
+        return self.word(x)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(H)
+        self.fc1 = nn.Linear(H, 4 * H)
+        self.fc2 = nn.Linear(4 * H, H)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+
+class HeadPipe(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.proj = nn.Linear(H, VOCAB)
+
+    def forward(self, x):
+        return self.proj(x)
+
+
+def ce_loss(logits, labels):
+    l = logits._data if isinstance(logits, Tensor) else logits
+    y = labels._data if isinstance(labels, Tensor) else labels
+    logz = jax.nn.logsumexp(l, axis=-1)
+    gold = jnp.take_along_axis(l, y[..., None], axis=-1)[..., 0]
+    return Tensor._wrap(jnp.mean(logz - gold))
+
+
+def _compiled_temp_bytes(schedule):
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 4, "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "schedule": schedule}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = PipelineLayer(
+        layers=[LayerDesc(EmbedPipe), *[LayerDesc(Block) for _ in range(8)],
+                LayerDesc(HeadPipe)],
+        num_stages=4, loss_fn=ce_loss,
+    )
+    eng = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters()))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, VOCAB, (16, SEQ)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, VOCAB, (16, SEQ)), jnp.int32)
+    loss = eng.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)], opt)
+    assert np.isfinite(float(jax.device_get(loss._data)))
+    (_, step), = eng._step_cache.items()
+    lowered = step.lower(
+        eng._state, eng._opt_state,
+        eng._dp_shard_input(x), eng._dp_shard_input(y),
+        jnp.float32(1e-3), jnp.float32(1), jnp.float32(1.0),
+    )
+    mem = lowered.compile().memory_analysis()
+    return int(mem.temp_size_in_bytes)
+
+
+def test_1f1b_accepts_non_f32_loss():
+    # custom loss_fns need not upcast; the schedule casts to f32 itself
+    def bf16_loss(logits, labels):
+        l = ce_loss(logits, labels)
+        return Tensor._wrap(
+            (l._data if isinstance(l, Tensor) else l).astype(jnp.bfloat16))
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"pp_degree": 4, "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 4, "schedule": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = PipelineLayer(
+        layers=[LayerDesc(EmbedPipe), *[LayerDesc(Block) for _ in range(4)],
+                LayerDesc(HeadPipe)],
+        num_stages=4, loss_fn=bf16_loss,
+    )
+    eng = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        optimizer.SGD(learning_rate=1e-2, parameters=model.parameters()))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, VOCAB, (8, SEQ)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, VOCAB, (8, SEQ)), jnp.int32)
+    loss = eng.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)], opt)
+    assert np.isfinite(float(jax.device_get(loss._data)))
+
+
+def test_1f1b_temp_memory_below_gpipe():
+    gpipe = _compiled_temp_bytes("gpipe")
+    f1b1 = _compiled_temp_bytes("1F1B")
+    assert f1b1 < gpipe, (
+        f"1F1B temp {f1b1/1e6:.2f}MB not below GPipe {gpipe/1e6:.2f}MB"
+    )

@@ -100,6 +100,11 @@ class PipelineParallel(MetaParallelBase):
         )
         self._pp = (hcg.get_pipe_parallel_world_size() if hcg is not None
                     else layers.get_num_stages())
+        self._vpp = layers.get_num_virtual_stages()
+        if self._vpp > 1 and self._schedule != "1f1b":
+            raise ValueError(
+                "num_virtual_pipeline_stages > 1 (interleave) requires "
+                "pipeline_configs.schedule='1F1B'")
         if self._pp != layers.get_num_stages():
             raise ValueError(
                 f"PipelineLayer built for {layers.get_num_stages()} stages but "
@@ -185,19 +190,30 @@ class PipelineParallel(MetaParallelBase):
             spec = _spec_for(p, mesh)
             state[key] = jax.device_put(p._data, NamedSharding(mesh, spec))
             decay[key] = self._decay_applies_param(p)
-        # body stacked
+        # body stacked: [pp, K, ...] for v=1, [pp, v, K', ...] interleaved
+        # (entry [s, c, k] = body layer (c*pp + s)*K' + k — virtual stage
+        # d = c*pp+s per the reference's chunk assignment)
         K = model.layers_per_stage
         if self._template is not None and K > 0:
+            v = self._vpp
+            Kc = model.layers_per_chunk
             leaves = [n for n, _ in self._template.named_parameters()]
             per_layer = [dict(l.named_parameters()) for l in model.body_layers]
             for leaf in leaves:
                 tmpl_p = dict(self._template.named_parameters())[leaf]
                 arrs = [pl[leaf]._data for pl in per_layer]
-                stacked = jnp.stack(arrs).reshape(
-                    (self._pp, K) + tuple(arrs[0].shape)
-                )
                 spec = _spec_for(tmpl_p, mesh)
-                full_spec = P("pp", None, *spec)
+                if v > 1:
+                    # flat layer order IS [v, pp, Kc]-major (layer
+                    # (c*pp+s)*Kc+k); transpose to [pp, v, Kc]
+                    stacked = jnp.stack(arrs).reshape(
+                        (v, self._pp, Kc) + tuple(arrs[0].shape)
+                    ).swapaxes(0, 1)
+                    full_spec = P("pp", None, None, *spec)
+                else:
+                    stacked = jnp.stack(arrs).reshape(
+                        (self._pp, K) + tuple(arrs[0].shape))
+                    full_spec = P("pp", None, *spec)
                 key = f"b::{leaf}"
                 state[key] = jax.device_put(
                     stacked, NamedSharding(mesh, full_spec)
@@ -223,10 +239,17 @@ class PipelineParallel(MetaParallelBase):
             p._data = self._state[f"p::{self._alias.get(name, name)}"]
         K = model.layers_per_stage
         if self._template is not None and K > 0:
+            v = self._vpp
+            Kc = model.layers_per_chunk
             per_layer = [dict(l.named_parameters()) for l in model.body_layers]
             for leaf in [n for n, _ in self._template.named_parameters()]:
                 stacked = self._state[f"b::{leaf}"]
-                flat = stacked.reshape((-1,) + tuple(stacked.shape[2:]))
+                if v > 1:
+                    # [pp, v, Kc, ...] -> flat layer order [v*pp*Kc, ...]
+                    stacked = stacked.swapaxes(0, 1)
+                    flat = stacked.reshape((-1,) + tuple(stacked.shape[3:]))
+                else:
+                    flat = stacked.reshape((-1,) + tuple(stacked.shape[2:]))
                 for i, pl in enumerate(per_layer):
                     pl[leaf]._data = flat[i]
 
@@ -272,7 +295,39 @@ class PipelineParallel(MetaParallelBase):
                     hdata, NamedSharding(mesh, P(dp_axes))
                 )
 
-            if pp > 1 and K > 0:
+            if self._vpp > 1 and K > 0:
+                # interleaved stacking [pp, v, Kc, ...]: evaluate the body
+                # sequentially in virtual-stage order, one microbatch at a
+                # time (lax.map bounds activation memory the way the
+                # pipelined eval does; compute is replicated over pp —
+                # training goes through _pipeline_interleaved_grads)
+                from ....framework import random as _random
+                from ....jit import functional_call
+
+                body_state = {
+                    n[len("b::"):]: a for n, a in state.items()
+                    if n.startswith("b::")
+                }
+                Kc = model.layers_per_chunk
+                full = hdata.shape
+                M = micro
+                xs = hdata.reshape((M, full[0] // M) + tuple(full[1:]))
+
+                def seq_chunks(mb_h):
+                    c = mb_h
+                    for d in range(self._pp * self._vpp):
+                        s_, ch = d % self._pp, d // self._pp
+                        for k in range(Kc):
+                            leaf = jax.tree_util.tree_map(
+                                lambda a, s_=s_, ch=ch, k=k: a[s_, ch, k],
+                                body_state)
+                            with _random.derived_context(d, k):
+                                c = functional_call(
+                                    template, leaf, Tensor._wrap(c))
+                    return c
+
+                h = Tensor._wrap(jax.lax.map(seq_chunks, xs).reshape(full))
+            elif pp > 1 and K > 0:
                 M = micro
                 body_state = {
                     n[len("b::"):]: a for n, a in state.items()
@@ -390,6 +445,68 @@ class PipelineParallel(MetaParallelBase):
         return h
 
     # ------------------------------------------------------------- 1F1B path
+    def _seg_helpers(self):
+        """pre/post+loss segment closures shared by both 1F1B paths."""
+        from ....framework import random as _random
+
+        model = self._layers
+        loss_head = model._loss_fn
+
+        def pre_apply(prepost_t, tok, mb_ix):
+            with self._swapped(prepost_t), pause_tape():
+                h = Tensor._wrap(tok)
+                for i, layer in enumerate(model.pre_layers):
+                    with _random.derived_context(mb_ix, 1000 + i):
+                        h = layer(h)
+            return h._data if isinstance(h, Tensor) else h
+
+        def post_loss_apply(prepost_t, h_arr, y_mb, mb_ix):
+            with self._swapped(prepost_t), pause_tape():
+                h = Tensor._wrap(h_arr)
+                for i, layer in enumerate(model.post_layers):
+                    with _random.derived_context(mb_ix, 2000 + i):
+                        h = layer(h)
+                l = loss_head(h, Tensor._wrap(y_mb))
+            l = l._data if isinstance(l, Tensor) else l
+            # f32 regardless of loss_fn dtype: the switch branches and the
+            # vjp cotangent seed both assume a float32 scalar
+            return jnp.mean(l.astype(jnp.float32))
+
+        return pre_apply, post_loss_apply
+
+    def _microbatch_io(self, x_arr, y_arr, M):
+        """Reshape global-batch inputs to [M, mb, ...] with the dp×sharding
+        layout constrained through the reshape."""
+        mesh = self._get_mesh()
+        dp_axes = self._dp_axes()
+        xs = x_arr.reshape((M, x_arr.shape[0] // M) + tuple(x_arr.shape[1:]))
+        ys = y_arr.reshape((M, y_arr.shape[0] // M) + tuple(y_arr.shape[1:]))
+        if dp_axes:
+            xs = jax.lax.with_sharding_constraint(
+                xs, NamedSharding(mesh, P(None, dp_axes)))
+            ys = jax.lax.with_sharding_constraint(
+                ys, NamedSharding(mesh, P(None, dp_axes)))
+        return xs, ys
+
+    def _run_pipe(self, pipe, prepost, body_state, xs, ys, scale, M):
+        """shard_map invocation + grads/loss assembly shared by both
+        schedules (pipe returns (d_prepost, d_body, loss_sum))."""
+        mesh = self._get_mesh()
+        body_specs = jax.tree_util.tree_map(lambda _: P("pp"), body_state)
+        prepost_specs = jax.tree_util.tree_map(lambda _: P(), prepost)
+        with pause_tape():
+            dpp, dbody, lsum = jax.shard_map(
+                pipe,
+                mesh=mesh,
+                in_specs=(prepost_specs, body_specs, P(), P(), P()),
+                out_specs=(prepost_specs, body_specs, P()),
+                axis_names={"pp"},
+                check_vma=False,
+            )(prepost, body_state, xs, ys, scale)
+        grads = dict(dpp)
+        grads.update({f"b::{n}": g for n, g in dbody.items()})
+        return lsum / M, grads
+
     def _pipeline_1f1b_grads(self, state, x_arr, y_arr, M, scale):
         """One-scan compiled 1F1B: loss AND grads of the whole pipelined
         model (reference: pipeline_parallel.py forward_backward_pipeline).
@@ -408,42 +525,22 @@ class PipelineParallel(MetaParallelBase):
         classic staggered schedule buys nothing under XLA anyway.
         """
         model = self._layers
-        mesh = self._get_mesh()
         pp, K = self._pp, model.layers_per_stage
         template = self._template
-        loss_head = model._loss_fn
 
         from ....framework import random as _random
         from ....jit import functional_call
-
-        dp_axes = self._dp_axes()
 
         prepost = {n: a for n, a in state.items() if n.startswith("p::")}
         body_state = {
             n[len("b::"):]: a for n, a in state.items()
             if n.startswith("b::")
         }
-
-        full = x_arr.shape
-        mb = full[0] // M
-        xs = x_arr.reshape((M, mb) + tuple(full[1:]))
-        ys = y_arr.reshape((M, mb) + tuple(y_arr.shape[1:]))
-        if dp_axes:
-            xs = jax.lax.with_sharding_constraint(
-                xs, NamedSharding(mesh, P(None, dp_axes)))
-            ys = jax.lax.with_sharding_constraint(
-                ys, NamedSharding(mesh, P(None, dp_axes)))
+        xs, ys = self._microbatch_io(x_arr, y_arr, M)
         if _debug_inspect_xs is not None:
             jax.debug.inspect_array_sharding(
                 xs, callback=_debug_inspect_xs)
-
-        def pre_apply(prepost_t, tok, mb_ix):
-            with self._swapped(prepost_t), pause_tape():
-                h = Tensor._wrap(tok)
-                for i, layer in enumerate(model.pre_layers):
-                    with _random.derived_context(mb_ix, 1000 + i):
-                        h = layer(h)
-            return h._data if isinstance(h, Tensor) else h
+        pre_apply, post_loss_apply = self._seg_helpers()
 
         def body_apply(loc, h, mb_ix):
             stage_ix = jax.lax.axis_index("pp")
@@ -458,18 +555,6 @@ class PipelineParallel(MetaParallelBase):
 
             h, _ = jax.lax.scan(layer_step, h, (jnp.arange(K), loc))
             return h
-
-        def post_loss_apply(prepost_t, h_arr, y_mb, mb_ix):
-            with self._swapped(prepost_t), pause_tape():
-                h = Tensor._wrap(h_arr)
-                for i, layer in enumerate(model.post_layers):
-                    with _random.derived_context(mb_ix, 2000 + i):
-                        h = layer(h)
-                l = loss_head(h, Tensor._wrap(y_mb))
-            l = l._data if isinstance(l, Tensor) else l
-            # f32 regardless of loss_fn dtype: the switch branches and the
-            # vjp cotangent seed both assume a float32 scalar
-            return jnp.mean(l.astype(jnp.float32))
 
         act_aval = jax.eval_shape(
             lambda pt, tok: pre_apply(pt, tok, 0), prepost, xs[0])
@@ -576,21 +661,181 @@ class PipelineParallel(MetaParallelBase):
             dbody = jax.tree_util.tree_map(lambda g: g[None], dloc)
             return dpp, dbody, lsum
 
-        body_specs = jax.tree_util.tree_map(lambda _: P("pp"), body_state)
-        prepost_specs = jax.tree_util.tree_map(lambda _: P(), prepost)
-        with pause_tape():
-            dpp, dbody, lsum = jax.shard_map(
-                pipe,
-                mesh=mesh,
-                in_specs=(prepost_specs, body_specs, P(), P(), P()),
-                out_specs=(prepost_specs, body_specs, P()),
-                axis_names={"pp"},
-                check_vma=False,
-            )(prepost, body_state, xs, ys, scale)
-        grads = dict(dpp)
-        grads.update({f"b::{n}": g for n, g in dbody.items()})
-        loss = lsum / M
-        return loss, grads
+        return self._run_pipe(pipe, prepost, body_state, xs, ys, scale, M)
+
+    # ------------------------------------------------- interleaved 1F1B path
+    def _pipeline_interleaved_grads(self, state, x_arr, y_arr, M, scale):
+        """Interleaved (virtual-pipeline) 1F1B (reference:
+        PipelineParallelWithInterleave).  Device s runs virtual stages
+        d = c*pp + s; the static schedule tables (interleave_schedule.py)
+        drive a single scan whose tick body does at most one forward and one
+        backward unit per device, routing activations/cotangents through
+        liveness-verified ring buffers.  Backward units rematerialize their
+        chunk's forward from the stashed chunk input (jax.vjp), as in the
+        non-interleaved 1F1B path."""
+        from .interleave_schedule import build_interleaved_schedule
+
+        model = self._layers
+        pp, v = self._pp, self._vpp
+        Kc = model.layers_per_chunk
+        D = pp * v
+        template = self._template
+
+        from ....framework import random as _random
+        from ....jit import functional_call
+
+        tab = build_interleaved_schedule(pp, v, M)
+        T, n_in, n_cot = tab["T"], tab["n_in_slots"], tab["n_cot_slots"]
+        rows = {k: jnp.asarray(a) for k, a in tab.items()
+                if isinstance(a, np.ndarray)}
+
+        prepost = {n: a for n, a in state.items() if n.startswith("p::")}
+        body_state = {
+            n[len("b::"):]: a for n, a in state.items()
+            if n.startswith("b::")
+        }
+        xs, ys = self._microbatch_io(x_arr, y_arr, M)
+        pre_apply, post_loss_apply = self._seg_helpers()
+
+        def body_apply(loc_c, h, chunk, mb_ix):
+            stage_ix = jax.lax.axis_index("pp")
+
+            def layer_step(c, k_leaf):
+                k, leaf = k_leaf
+                with _random.derived_context(stage_ix, chunk, mb_ix, k):
+                    out = functional_call(template, leaf, Tensor._wrap(c))
+                return out, None
+
+            h, _ = jax.lax.scan(layer_step, h, (jnp.arange(Kc), loc_c))
+            return h
+
+        act_aval = jax.eval_shape(
+            lambda pt, tok: pre_apply(pt, tok, 0), prepost, xs[0])
+
+        def pipe(prepost_t, body_t, xs, ys, scale_in):
+            stage = jax.lax.axis_index("pp")
+            loc_all = jax.tree_util.tree_map(lambda a: a[0], body_t)
+            act0 = jnp.zeros(act_aval.shape, act_aval.dtype)
+            in_buf0 = jnp.zeros((v, n_in) + tuple(act_aval.shape),
+                                act_aval.dtype)
+            cot_buf0 = jnp.zeros((v, n_cot) + tuple(act_aval.shape),
+                                 act_aval.dtype)
+            dpp0 = jax.tree_util.tree_map(jnp.zeros_like, prepost_t)
+            dloc0 = jax.tree_util.tree_map(jnp.zeros_like, loc_all)
+            perm_fwd = [(i, (i + 1) % pp) for i in range(pp)]
+            perm_bwd = [((i + 1) % pp, i) for i in range(pp)]
+
+            def at2(buf, c, s_):
+                return jax.lax.dynamic_index_in_dim(
+                    jax.lax.dynamic_index_in_dim(buf, c, 0, keepdims=False),
+                    s_, 0, keepdims=False)
+
+            def put2(buf, c, s_, val, pred):
+                cur = at2(buf, c, s_)
+                return jax.lax.dynamic_update_slice(
+                    buf, jnp.where(pred, val, cur)[None, None],
+                    (c, s_) + (0,) * val.ndim)
+
+            def tick(carry, row):
+                act_msg, cot_msg, in_buf, cot_buf, dpp, dloc, lsum = carry
+                g = lambda name: jax.lax.dynamic_index_in_dim(
+                    row[name], stage, 0, keepdims=False)
+
+                # 1. stash arrivals from last tick's permutes
+                in_buf = put2(in_buf, g("ra_chunk"), g("ra_slot"),
+                              act_msg, g("ra_valid") == 1)
+                cot_buf = put2(cot_buf, g("rc_chunk"), g("rc_slot"),
+                               cot_msg, g("rc_valid") == 1)
+
+                # 2. forward unit
+                fc_, fmb = g("f_chunk"), jnp.clip(g("f_mb"), 0, M - 1)
+                d_f = fc_ * pp + stage
+                cls_f = jnp.where(d_f == 0, 0,
+                                  jnp.where(d_f == D - 1, 2, 1))
+                loc_f = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, fc_, 0, keepdims=False), loc_all)
+                x_f = jax.lax.dynamic_index_in_dim(
+                    xs, fmb, 0, keepdims=False)
+                src_f = at2(in_buf, fc_, g("f_slot"))
+                out_act = jax.lax.switch(cls_f, [
+                    lambda _: body_apply(
+                        loc_f, pre_apply(prepost_t, x_f, fmb), fc_, fmb),
+                    lambda _: body_apply(loc_f, src_f, fc_, fmb),
+                    lambda _: jnp.zeros_like(act_msg),
+                ], None)
+
+                # 3. backward unit (remat + vjp of the chunk's segment)
+                bc_, bmb = g("b_chunk"), jnp.clip(g("b_mb"), 0, M - 1)
+                bvalid = g("b_valid") == 1
+                d_b = bc_ * pp + stage
+                cls_b = jnp.where(d_b == 0, 0,
+                                  jnp.where(d_b == D - 1, 2, 1))
+                loc_b = jax.tree_util.tree_map(
+                    lambda a: jax.lax.dynamic_index_in_dim(
+                        a, bc_, 0, keepdims=False), loc_all)
+                x_b = jax.lax.dynamic_index_in_dim(
+                    xs, bmb, 0, keepdims=False)
+                y_b = jax.lax.dynamic_index_in_dim(
+                    ys, bmb, 0, keepdims=False)
+                saved = at2(in_buf, bc_, g("b_slot"))
+                cot_in = at2(cot_buf, bc_, g("bc_slot"))
+
+                def bwd_first(_):
+                    def seg(pt, lc):
+                        return body_apply(
+                            lc, pre_apply(pt, x_b, bmb), bc_, bmb)
+
+                    _, vjp = jax.vjp(seg, prepost_t, loc_b)
+                    dpt, dlc = vjp(cot_in)
+                    return dpt, dlc, jnp.zeros_like(act_msg), jnp.float32(0)
+
+                def bwd_mid(_):
+                    def seg(lc, a):
+                        return body_apply(lc, a, bc_, bmb)
+
+                    _, vjp = jax.vjp(seg, loc_b, saved)
+                    dlc, din = vjp(cot_in)
+                    return (jax.tree_util.tree_map(jnp.zeros_like,
+                                                   prepost_t),
+                            dlc, din, jnp.float32(0))
+
+                def bwd_last(_):
+                    def seg(pt, lc, a):
+                        return post_loss_apply(
+                            pt, body_apply(lc, a, bc_, bmb), y_b, bmb)
+
+                    lval, vjp = jax.vjp(seg, prepost_t, loc_b, saved)
+                    dpt, dlc, din = vjp(
+                        scale_in.astype(jnp.float32) / jnp.float32(M))
+                    return dpt, dlc, din, lval
+
+                dpt_c, dlc_c, din_c, lval = jax.lax.switch(
+                    cls_b, [bwd_first, bwd_mid, bwd_last], None)
+
+                mask = lambda t_: jnp.where(bvalid, t_, jnp.zeros_like(t_))
+                dpp = jax.tree_util.tree_map(
+                    lambda acc, g_: acc + mask(g_), dpp, dpt_c)
+                # chunk grads scatter-add into their [v, ...] slot
+                dloc = jax.tree_util.tree_map(
+                    lambda acc, g_: acc.at[bc_].add(mask(g_)), dloc, dlc_c)
+                lsum = lsum + jnp.where(bvalid, lval, 0.0)
+
+                act_next = jax.lax.ppermute(out_act, "pp", perm_fwd)
+                cot_next = jax.lax.ppermute(din_c, "pp", perm_bwd)
+                return (act_next, cot_next, in_buf, cot_buf,
+                        dpp, dloc, lsum), None
+
+            carry0 = (act0, jnp.zeros_like(act0), in_buf0, cot_buf0,
+                      dpp0, dloc0, jnp.float32(0))
+            carry, _ = jax.lax.scan(tick, carry0, rows)
+            _, _, _, _, dpp, dloc, lsum = carry
+            dpp = jax.lax.psum(dpp, "pp")
+            lsum = jax.lax.psum(lsum, "pp")
+            dbody = jax.tree_util.tree_map(lambda g_: g_[None], dloc)
+            return dpp, dbody, lsum
+
+        return self._run_pipe(pipe, prepost, body_state, xs, ys, scale, M)
 
     # ---------------------------------------------------------------- public
     def forward(self, *args, **kwargs):
@@ -636,6 +881,17 @@ class PipelineParallel(MetaParallelBase):
             scaler is not None and getattr(scaler, "_enable", False)
         ) else 1.0
 
+        use_vpp = (self._vpp > 1 and self._pp > 1
+                   and self._layers.layers_per_stage > 0)
+        if use_vpp:
+            if self._layers._loss_fn is None:
+                raise ValueError(
+                    "interleaved pipeline training requires a loss_fn on "
+                    "the PipelineLayer")
+            if M % self._pp != 0:
+                raise ValueError(
+                    f"interleaved schedule needs accumulate_steps ({M}) "
+                    f"divisible by pp ({self._pp})")
         use_1f1b = (self._schedule == "1f1b" and self._pp > 1
                     and self._layers.layers_per_stage > 0
                     and self._layers._loss_fn is not None)
@@ -664,13 +920,16 @@ class PipelineParallel(MetaParallelBase):
                 return l * scale, l
 
             def loss_and_grads(state, x_in, y_in, scale, step_i):
-                if use_1f1b:
+                if use_vpp or use_1f1b:
                     from ....framework import random as _random
 
                     with _random.key_context(
                         jax.random.fold_in(_random.base_key(),
                                            step_i.astype(jnp.int32))
                     ):
+                        if use_vpp:
+                            return self._pipeline_interleaved_grads(
+                                state, x_in, y_in, M, scale)
                         return self._pipeline_1f1b_grads(
                             state, x_in, y_in, M, scale)
                 (_, loss), grads = jax.value_and_grad(

@@ -122,12 +122,9 @@ class PipelineLayer(nn.Layer):
         self._seg_method = seg_method
         self._recompute_interval = int(recompute_interval)
         self._topology = topology
-        if num_virtual_pipeline_stages not in (None, 1):
-            raise NotImplementedError(
-                "interleaved/virtual pipeline stages: planned (reference "
-                "PipelineParallelWithInterleave); the compiled 1F1B-equivalent "
-                "schedule subsumes most of its bubble win"
-            )
+        self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
+        if self._num_virtual_stages < 1:
+            raise ValueError("num_virtual_pipeline_stages must be >= 1")
 
         self._descs = list(layers)
         self._shared_masters = {}  # key -> materialized master layer
@@ -191,13 +188,15 @@ class PipelineLayer(nn.Layer):
                             "requires a homogeneous body"
                         )
         n_body = stop - start
-        if self._num_stages > 1:
-            if n_body == 0 or n_body % self._num_stages != 0:
+        if self._num_stages * self._num_virtual_stages > 1:
+            chunks = self._num_stages * self._num_virtual_stages
+            if n_body == 0 or n_body % chunks != 0:
                 raise ValueError(
                     f"PipelineLayer: homogeneous body of {n_body} layers "
                     f"(indices [{start},{stop})) is not divisible by "
-                    f"num_stages={self._num_stages}; pad the block count or "
-                    f"change seg_method (got {self._seg_method!r})"
+                    f"num_stages={self._num_stages} x "
+                    f"virtual={self._num_virtual_stages}; pad the block "
+                    f"count or change seg_method (got {self._seg_method!r})"
                 )
         self._body_range = (start, stop)
 
@@ -215,10 +214,19 @@ class PipelineLayer(nn.Layer):
 
     @property
     def layers_per_stage(self) -> int:
+        """Body layers per physical stage (across all virtual chunks)."""
         return len(self.body_layers) // max(1, self._num_stages)
+
+    @property
+    def layers_per_chunk(self) -> int:
+        """Body layers per virtual stage (chunk)."""
+        return self.layers_per_stage // max(1, self._num_virtual_stages)
 
     def get_num_stages(self) -> int:
         return self._num_stages
+
+    def get_num_virtual_stages(self) -> int:
+        return self._num_virtual_stages
 
     def segment_describe(self) -> str:
         a, b = self._body_range

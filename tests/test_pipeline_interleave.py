@@ -1,0 +1,181 @@
+"""Interleaved (virtual-pipeline) 1F1B tests (reference:
+PipelineParallelWithInterleave, hybrid_parallel_pp_layer_with_virtual_stage
+twin pattern: interleaved training must match the sequential run)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import (
+    LayerDesc, PipelineLayer,
+)
+from paddle_tpu.distributed.fleet.meta_parallel.interleave_schedule import (
+    build_interleaved_schedule,
+)
+from paddle_tpu.framework.tensor import Tensor
+
+H = 16
+VOCAB = 37
+SEQ = 8
+
+
+class EmbedPipe(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.word = nn.Embedding(VOCAB, H)
+
+    def forward(self, x):
+        return self.word(x)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(H)
+        self.fc1 = nn.Linear(H, 4 * H)
+        self.fc2 = nn.Linear(4 * H, H)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + self.fc2(F.gelu(self.fc1(self.ln(x))))
+
+
+class HeadPipe(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.ln = nn.LayerNorm(H)
+        self.proj = nn.Linear(H, VOCAB)
+
+    def forward(self, x):
+        return self.proj(self.ln(x))
+
+
+def ce_loss(logits, labels):
+    l = logits._data if isinstance(logits, Tensor) else logits
+    y = labels._data if isinstance(labels, Tensor) else labels
+    logz = jax.nn.logsumexp(l, axis=-1)
+    gold = jnp.take_along_axis(l, y[..., None], axis=-1)[..., 0]
+    return Tensor._wrap(jnp.mean(logz - gold))
+
+
+class TestScheduleTables:
+    @pytest.mark.parametrize("pp,v,M", [(2, 2, 4), (4, 2, 8), (2, 3, 6),
+                                        (4, 1, 8), (2, 4, 8)])
+    def test_dependencies_and_coverage(self, pp, v, M):
+        tab = build_interleaved_schedule(pp, v, M)
+        D = pp * v
+        T = tab["T"]
+        # reconstruct completion ticks
+        done = {}
+        for t in range(T):
+            for s in range(pp):
+                if tab["f_valid"][t, s]:
+                    done[("F", tab["f_chunk"][t, s] * pp + s,
+                          tab["f_mb"][t, s])] = t
+                if tab["b_valid"][t, s]:
+                    done[("B", tab["b_chunk"][t, s] * pp + s,
+                          tab["b_mb"][t, s])] = t
+        assert len(done) == 2 * D * M  # every op exactly once
+        for d in range(D):
+            for f in range(M):
+                if d > 0:
+                    assert done[("F", d, f)] > done[("F", d - 1, f)]
+                    assert done[("B", d, f)] > done[("B", d + 1, f)] \
+                        if d < D - 1 else True
+                if d < D - 1:
+                    assert done[("B", d, f)] > done[("B", d + 1, f)]
+                assert done[("B", d, f)] > done[("F", d, f)]
+        # schedule achieves the ideal async 1F1B length
+        assert T == 2 * M * v + 2 * (pp - 1)
+
+    def test_rejects_bad_microbatch_count(self):
+        with pytest.raises(ValueError, match="accumulate_steps"):
+            build_interleaved_schedule(4, 2, 6)
+
+    def test_indivisible_body_with_virtual_stages_raises(self):
+        # even at num_stages=1 a non-divisible body must not silently drop
+        # trailing layers
+        with pytest.raises(ValueError, match="not divisible"):
+            PipelineLayer(
+                layers=[LayerDesc(Block) for _ in range(9)],
+                num_stages=1, num_virtual_pipeline_stages=2)
+
+
+class TestInterleaveTwin:
+    def test_pp2_v2_matches_sequential_training(self, rng):
+        strategy = DistributedStrategy()
+        strategy.hybrid_configs = {"pp_degree": 2, "mp_degree": 1}
+        strategy.pipeline_configs = {"accumulate_steps": 4}
+        fleet.init(is_collective=True, strategy=strategy)
+
+        def descs():
+            return [LayerDesc(EmbedPipe),
+                    *[LayerDesc(Block) for _ in range(8)],
+                    LayerDesc(HeadPipe)]
+
+        pipe_model = PipelineLayer(layers=descs(), num_stages=2,
+                                   loss_fn=ce_loss,
+                                   num_virtual_pipeline_stages=2)
+        assert pipe_model.layers_per_chunk == 2
+        twin = PipelineLayer(layers=descs(), num_stages=1, loss_fn=ce_loss)
+        s = dict(pipe_model.named_parameters())
+        for n, p in twin.named_parameters():
+            p._data = s[n]._data
+
+        engine = fleet.distributed_model(pipe_model)
+        opt = fleet.distributed_optimizer(optimizer.AdamW(
+            learning_rate=1e-2, parameters=pipe_model.parameters()))
+
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        tp = param_arrays(twin)
+        topt = optimizer.AdamW(learning_rate=1e-2)
+        tstate = topt.init_state_tree(tp)
+
+        @jax.jit
+        def twin_step(params, st, x, y, step_i):
+            def loss_fn(p):
+                out = functional_call(twin, p, Tensor._wrap(x))
+                return ce_loss(Tensor._wrap(out), Tensor._wrap(y))._data
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            decay = {k: (not k.endswith("bias")) and params[k].ndim > 1
+                     for k in params}
+            new_p, new_s = topt.apply_gradients_tree(
+                params, grads, st, 1e-2, step_i, decay_mask_tree=decay)
+            return new_p, new_s, loss
+
+        losses_pp, losses_twin = [], []
+        for i in range(3):
+            x = jnp.asarray(rng.integers(0, VOCAB, (8, SEQ)), jnp.int32)
+            y = jnp.asarray(rng.integers(0, VOCAB, (8, SEQ)), jnp.int32)
+            loss = engine.train_batch(
+                [paddle.to_tensor(x), paddle.to_tensor(y)], opt)
+            losses_pp.append(float(jax.device_get(loss._data)))
+            tp, tstate, tl = twin_step(tp, tstate, x, y, jnp.float32(i + 1))
+            losses_twin.append(float(jax.device_get(tl)))
+
+        np.testing.assert_allclose(losses_pp, losses_twin, rtol=5e-4,
+                                   err_msg=f"{losses_pp} vs {losses_twin}")
+        assert losses_pp[-1] < losses_pp[0]
+
+        engine._sync_to_model()
+        for n, p in pipe_model.named_parameters():
+            np.testing.assert_allclose(
+                np.asarray(p._data), np.asarray(tp[n]), atol=3e-4,
+                err_msg=n)
+
+        # eval path (sequential over virtual stages) matches the twin fwd
+        x = jnp.asarray(rng.integers(0, VOCAB, (8, SEQ)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, VOCAB, (8, SEQ)), jnp.int32)
+        ev = engine.eval_batch([paddle.to_tensor(x), paddle.to_tensor(y)])
+        tw = ce_loss(Tensor._wrap(functional_call(
+            twin, tp, Tensor._wrap(x))), Tensor._wrap(y))
+        np.testing.assert_allclose(
+            float(jax.device_get(ev._data)),
+            float(jax.device_get(tw._data)), rtol=5e-4)

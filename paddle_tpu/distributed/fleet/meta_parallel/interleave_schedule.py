@@ -66,29 +66,49 @@ def build_interleaved_schedule(pp: int, v: int, M: int) -> Dict[str, np.ndarray]
     rows = []
     t = 0
     limit = 8 * M * v + 8 * pp * v + 16
+    # The engine's tick body always executes one forward AND one backward
+    # unit, so a tick that issues only one of the two wastes the other's
+    # compute.  Issue up to one F and one B per device per tick (the Megatron
+    # steady state is exactly F,B pairs; B units rematerialize from stashed
+    # chunk inputs, so an F and a B of the same tick never feed each other —
+    # readiness only consults ops completed on PRIOR ticks).
     while any(pos[s] < len(seqs[s]) for s in range(pp)):
         if t > limit:
             raise RuntimeError("interleave schedule failed to converge")
         row = []
         for s in range(pp):
-            op = seqs[s][pos[s]] if pos[s] < len(seqs[s]) else None
-            if op is None:
-                row.append(None)
-                continue
-            kind, c, f = op
-            d = c * pp + s
-            if kind == "F":
-                ready = d == 0 or ("F", d - 1, f) in done
-            else:
-                ready = (("F", d, f) in done if d == D - 1
-                         else ("B", d + 1, f) in done)
-            row.append(op if ready else None)
-        for s, op in enumerate(row):
-            if op is not None:
-                kind, c, f = op
-                done[(kind, c * pp + s, f)] = t
-                pos[s] += 1
-        rows.append(row)
+            f_op = b_op = None
+            take = 0
+            for _ in range(2):
+                i = pos[s] + take
+                if i >= len(seqs[s]):
+                    break
+                kind, c, f = seqs[s][i]
+                d = c * pp + s
+                if kind == "F":
+                    if f_op is not None:
+                        break
+                    ready = d == 0 or ("F", d - 1, f) in done
+                    if not ready:
+                        break
+                    f_op = (kind, c, f)
+                else:
+                    if b_op is not None:
+                        break
+                    ready = (("F", d, f) in done if d == D - 1
+                             else ("B", d + 1, f) in done)
+                    if not ready:
+                        break
+                    b_op = (kind, c, f)
+                take += 1
+            row.append((f_op, b_op, take))
+        for s, (f_op, b_op, take) in enumerate(row):
+            for op in (f_op, b_op):
+                if op is not None:
+                    kind, c, f = op
+                    done[(kind, c * pp + s, f)] = t
+            pos[s] += take
+        rows.append([(f_op, b_op) for f_op, b_op, _ in row])
         t += 1
     T = len(rows)
 
@@ -136,12 +156,10 @@ def build_interleaved_schedule(pp: int, v: int, M: int) -> Dict[str, np.ndarray]
             "ra_valid", "ra_chunk", "ra_slot",
             "rc_valid", "rc_chunk", "rc_slot")}
     for ti, row in enumerate(rows):
-        for s, op in enumerate(row):
-            if op is None:
-                continue
-            kind, c, f = op
-            d = c * pp + s
-            if kind == "F":
+        for s, (f_op, b_op) in enumerate(row):
+            if f_op is not None:
+                _, c, f = f_op
+                d = c * pp + s
                 tab["f_valid"][ti, s] = 1
                 tab["f_chunk"][ti, s] = c
                 tab["f_mb"][ti, s] = f
@@ -154,7 +172,9 @@ def build_interleaved_schedule(pp: int, v: int, M: int) -> Dict[str, np.ndarray]
                     tab["ra_valid"][ti + 1, s2] = 1
                     tab["ra_chunk"][ti + 1, s2] = c2
                     tab["ra_slot"][ti + 1, s2] = in_slot[(s2, c2, f)]
-            else:
+            if b_op is not None:
+                _, c, f = b_op
+                d = c * pp + s
                 tab["b_valid"][ti, s] = 1
                 tab["b_chunk"][ti, s] = c
                 tab["b_mb"][ti, s] = f

@@ -312,8 +312,16 @@ class PipelineParallel(MetaParallelBase):
                 full = hdata.shape
                 M = micro
                 xs = hdata.reshape((M, full[0] // M) + tuple(full[1:]))
+                if dp_axes:
+                    xs = jax.lax.with_sharding_constraint(
+                        xs, NamedSharding(mesh, P(None, dp_axes))
+                    )
 
-                def seq_chunks(mb_h):
+                def seq_chunks(args):
+                    # fold the microbatch index into the dropout context —
+                    # lax.map traces once, so without it every microbatch
+                    # would reuse identical masks
+                    mb_h, mb_ix = args
                     c = mb_h
                     for d in range(self._pp * self._vpp):
                         s_, ch = d % self._pp, d // self._pp
@@ -321,12 +329,13 @@ class PipelineParallel(MetaParallelBase):
                             leaf = jax.tree_util.tree_map(
                                 lambda a, s_=s_, ch=ch, k=k: a[s_, ch, k],
                                 body_state)
-                            with _random.derived_context(d, k):
+                            with _random.derived_context(mb_ix, d, k):
                                 c = functional_call(
                                     template, leaf, Tensor._wrap(c))
                     return c
 
-                h = Tensor._wrap(jax.lax.map(seq_chunks, xs).reshape(full))
+                h = Tensor._wrap(jax.lax.map(
+                    seq_chunks, (xs, jnp.arange(M))).reshape(full))
             elif pp > 1 and K > 0:
                 M = micro
                 body_state = {

@@ -40,9 +40,16 @@ def _interpret() -> bool:
 # --------------------------------------------------------------------- fwd
 
 
-def _mask_logits(s, *, causal, kv_valid, block_q, block_k, iq, ik):
+def _mask_logits(s, *, causal, kv_valid, block_q, block_k, iq, ik, pos=None):
     """Apply causal and/or kv-padding validity masks. ``kv_valid`` is the
-    original (unpadded) kv length, or None when no padding was added."""
+    original (unpadded) kv length, or None when no padding was added.
+    ``pos`` — optional ``(q_ids [bq,1], k_ids [1,bk])`` float32 global token
+    positions; when given, the mask is ``q_ids >= k_ids`` (position-driven
+    causality — what ring attention with zig-zag layouts needs) and the iota
+    paths are skipped (padding is handled by sentinel positions)."""
+    if pos is not None:
+        q_ids, k_ids = pos
+        return jnp.where(q_ids >= k_ids, s, NEG_INF)
     if not causal and kv_valid is None:
         return s
     k_ids = ik * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -55,8 +62,23 @@ def _mask_logits(s, *, causal, kv_valid, block_q, block_k, iq, ik):
     return jnp.where(keep, s, NEG_INF)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                scale, causal, kv_valid, block_q, block_k, num_kv):
+def _guard_p(s, p):
+    """Zero attention weights at masked logits. Only needed in position-mask
+    mode, where rows can be FULLY masked (ring-attention blocks whose whole
+    q chunk precedes the kv chunk): there m/lse sit at ~NEG_INF, so
+    ``exp(s - m)`` would be exp(0)=1 at masked entries. In plain causal mode
+    every row attends column 0, so m/lse are always finite and masked
+    entries exp to 0 on their own. Real logits never approach NEG_INF/2."""
+    return jnp.where(s > NEG_INF * 0.5, p, 0.0)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, scale, causal, kv_valid,
+                block_q, block_k, num_kv, pos_mask):
+    if pos_mask:
+        qp_ref, kp_ref, o_ref, lse_ref, m_s, l_s, acc_s = rest
+    else:
+        qp_ref, kp_ref = None, None
+        o_ref, lse_ref, m_s, l_s, acc_s = rest
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -79,14 +101,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # [bq, bk]
+        pos = (qp_ref[...], kp_ref[...]) if pos_mask else None
         s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=block_q,
-                         block_k=block_k, iq=iq, ik=ik)
+                         block_k=block_k, iq=iq, ik=ik, pos=pos)
 
         m_prev = m_s[:, :1]  # [bq, 1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
+        # keep m at NEG_INF while every block so far is fully masked, so the
+        # final lse of such rows is ~NEG_INF (≈ -inf), which the online merge
+        # in ring attention relies on
         alpha = jnp.exp(m_prev - m_new)  # [bq, 1]
         p = jnp.exp(s - m_new)  # [bq, bk]
+        if pos_mask:
+            p = _guard_p(s, p)
         l_new = alpha * l_s[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
 
         acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
@@ -105,23 +133,32 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         lse_ref[0] = (m_s[:] + jnp.log(jnp.maximum(l_s[:], 1e-37))).astype(jnp.float32)
 
 
-def _fwd(q, k, v, *, scale, causal, kv_valid, block_q, block_k):
+def _fwd(q, k, v, qp=None, kp=None, *, scale, causal, kv_valid, block_q, block_k):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
     grid = (bh, nq, nk)
+    pos_mask = qp is not None
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, kv_valid=kv_valid,
-        block_q=block_q, block_k=block_k, num_kv=nk,
+        block_q=block_q, block_k=block_k, num_kv=nk, pos_mask=pos_mask,
     )
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    inputs = [q, k, v]
+    if pos_mask:
+        in_specs += [
+            pl.BlockSpec((block_q, 1), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),
+        ]
+        inputs += [qp, kp]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
@@ -136,15 +173,20 @@ def _fwd(q, k, v, *, scale, causal, kv_valid, block_q, block_k):
             pltpu.VMEM((block_q, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*inputs)
     return out, lse[:, :, :1]  # lse [bh, sq, 1]
 
 
 # --------------------------------------------------------------------- bwd
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-                dk_s, dv_s, *, scale, causal, kv_valid, block_q, block_k, num_q):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                scale, causal, kv_valid, block_q, block_k, num_q, pos_mask):
+    if pos_mask:
+        qp_ref, kp_ref, dk_ref, dv_ref, dk_s, dv_s = rest
+    else:
+        qp_ref, kp_ref = None, None
+        dk_ref, dv_ref, dk_s, dv_s = rest
     iq = pl.program_id(2)
 
     @pl.when(iq == 0)
@@ -162,9 +204,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        pos = (qp_ref[...], kp_ref[...]) if pos_mask else None
         s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=block_q,
-                         block_k=block_k, iq=iq, ik=ik)
+                         block_k=block_k, iq=iq, ik=ik, pos=pos)
         p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
+        if pos_mask:
+            p = _guard_p(s, p)
         do = do_ref[0].astype(jnp.float32)
         # dV += P^T @ dO
         dv_s[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
@@ -185,8 +230,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
-               scale, causal, kv_valid, block_q, block_k, num_kv):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+               scale, causal, kv_valid, block_q, block_k, num_kv, pos_mask):
+    if pos_mask:
+        qp_ref, kp_ref, dq_ref, dq_s = rest
+    else:
+        qp_ref, kp_ref = None, None
+        dq_ref, dq_s = rest
     ik = pl.program_id(2)
 
     @pl.when(ik == 0)
@@ -203,9 +253,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
         k = k_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
+        pos = (qp_ref[...], kp_ref[...]) if pos_mask else None
         s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=block_q,
-                         block_k=block_k, iq=iq, ik=ik)
+                         block_k=block_k, iq=iq, ik=ik, pos=pos)
         p = jnp.exp(s - lse_ref[0][:, :1])
+        if pos_mask:
+            p = _guard_p(s, p)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -220,29 +273,42 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_s, *,
         dq_ref[0] = dq_s[:].astype(dq_ref.dtype)
 
 
-def _bwd(scale, causal, kv_valid, block_q, block_k, res, do):
-    q, k, v, out, lse = res
+def _bwd(scale, causal, kv_valid, block_q, block_k, res, do, dlse=None):
+    q, k, v, out, lse, qp, kp = res
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = sq // block_q, sk // block_k
+    pos_mask = qp is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)  # [bh, sq, 1]
+    if dlse is not None:
+        # lse cotangent folds into delta: ds = P·(dP − Δ + g) = P·(dP − (Δ − g))
+        delta = delta - dlse.astype(jnp.float32)
     lse_b = jnp.broadcast_to(lse, (bh, sq, 128))
     delta_b = jnp.broadcast_to(delta, (bh, sq, 128))
+
+    dkv_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
+    ]
+    dkv_inputs = [q, k, v, do, lse_b, delta_b]
+    if pos_mask:
+        dkv_in_specs += [
+            pl.BlockSpec((block_q, 1), lambda b, j, i: (i, 0)),
+            pl.BlockSpec((1, block_k), lambda b, j, i: (0, j)),
+        ]
+        dkv_inputs += [qp, kp]
 
     dkv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
                           kv_valid=kv_valid, block_q=block_q, block_k=block_k,
-                          num_q=nq),
+                          num_q=nq, pos_mask=pos_mask),
         grid=(bh, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -256,27 +322,36 @@ def _bwd(scale, causal, kv_valid, block_q, block_k, res, do):
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse_b, delta_b)
+    )(*dkv_inputs)
     dk, dv = dkv
+
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_inputs = [q, k, v, do, lse_b, delta_b]
+    if pos_mask:
+        dq_in_specs += [
+            pl.BlockSpec((block_q, 1), lambda b, i, j: (i, 0)),
+            pl.BlockSpec((1, block_k), lambda b, i, j: (0, j)),
+        ]
+        dq_inputs += [qp, kp]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
                           kv_valid=kv_valid, block_q=block_q, block_k=block_k,
-                          num_kv=nk),
+                          num_kv=nk, pos_mask=pos_mask),
         grid=(bh, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse_b, delta_b)
+    )(*dq_inputs)
     return dq, dk, dv
 
 
@@ -293,7 +368,7 @@ def _flash_bhsd(q, k, v, scale, causal, kv_valid, block_q, block_k):
 def _flash_fwd_rule(q, k, v, scale, causal, kv_valid, block_q, block_k):
     out, lse = _fwd(q, k, v, scale=scale, causal=causal, kv_valid=kv_valid,
                     block_q=block_q, block_k=block_k)
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, out, lse, None, None)
 
 
 def _flash_bwd_rule(scale, causal, kv_valid, block_q, block_k, res, do):
@@ -301,6 +376,70 @@ def _flash_bwd_rule(scale, causal, kv_valid, block_q, block_k, res, do):
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+# Joint (out, lse) variant with optional position-driven masks. The lse
+# output is what blockwise/ring attention merges partial results with; its
+# cotangent re-enters the same bwd kernels via delta (see _bwd). Positions
+# are float32 arrays ([sq,1] / [1,sk]) so custom_vjp can hand back ordinary
+# zero cotangents for them; f32 is exact for any realistic token index.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd_lse(q, k, v, qp, kp, scale, causal, kv_valid, block_q, block_k):
+    return _fwd(q, k, v, qp, kp, scale=scale, causal=causal,
+                kv_valid=kv_valid, block_q=block_q, block_k=block_k)
+
+
+def _flash_lse_fwd_rule(q, k, v, qp, kp, scale, causal, kv_valid, block_q,
+                        block_k):
+    out, lse = _fwd(q, k, v, qp, kp, scale=scale, causal=causal,
+                    kv_valid=kv_valid, block_q=block_q, block_k=block_k)
+    return (out, lse), (q, k, v, out, lse, qp, kp)
+
+
+def _flash_lse_bwd_rule(scale, causal, kv_valid, block_q, block_k, res, cts):
+    do, dlse = cts
+    dq, dk, dv = _bwd(scale, causal, kv_valid, block_q, block_k, res, do,
+                      dlse=dlse)
+    qp, kp = res[5], res[6]
+    dqp = None if qp is None else jnp.zeros_like(qp)
+    dkp = None if kp is None else jnp.zeros_like(kp)
+    return dq, dk, dv, dqp, dkp
+
+
+_flash_bhsd_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def _up8(n):
+    return ((n + 7) // 8) * 8
+
+
+def _prep_bhsd(q, k, v, block_q, block_k):
+    """Shared wrapper preamble: adaptive block sizing, seq/head-dim padding,
+    and [B,S,H,D] → [B*H,S,D] layout. Returns
+    ``(qb, kb, vb, block_q, block_k, qpad, kpad, dpad)``."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if block_q is None:
+        block_q = _up8(sq) if sq <= 1024 else 512
+    if block_k is None:
+        block_k = _up8(sk) if sk <= 1024 else 1024
+    block_q = min(block_q, _up8(sq))
+    block_k = min(block_k, _up8(sk))
+    qpad = (block_q - sq % block_q) % block_q
+    kpad = (block_k - sk % block_k) % block_k
+
+    # d ∈ {64, 128, 256}: no padding — Mosaic tiles 64-lane minors natively,
+    # and padding d doubles every dot and all q/k/v traffic (measured 2x)
+    dpad = 0 if d in (64, 128, 256) else (128 - d % 128) % 128
+
+    def to_bh(x, s, spad):
+        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
+        if spad or dpad:
+            x = jnp.pad(x, ((0, 0), (0, spad), (0, dpad)))
+        return x
+
+    return (to_bh(q, sq, qpad), to_bh(k, sk, kpad), to_bh(v, sk, kpad),
+            block_q, block_k, qpad, kpad, dpad)
 
 
 def flash_attention_fused(q, k, v, causal=True, scale=None,
@@ -312,31 +451,55 @@ def flash_attention_fused(q, k, v, causal=True, scale=None,
     sk = k.shape[1]
     if scale is None:
         scale = 1.0 / math.sqrt(d)
-    def _up8(n):
-        return ((n + 7) // 8) * 8
-
-    if block_q is None:
-        block_q = _up8(sq) if sq <= 1024 else 512
-    if block_k is None:
-        block_k = _up8(sk) if sk <= 1024 else 1024
-    block_q = min(block_q, _up8(sq))
-    block_k = min(block_k, _up8(sk))
-    qpad = (block_q - sq % block_q) % block_q
-    kpad = (block_k - sk % block_k) % block_k
+    qb, kb, vb, block_q, block_k, qpad, kpad, dpad = _prep_bhsd(
+        q, k, v, block_q, block_k)
     kv_valid = sk if kpad else None
-
-    # d ∈ {64, 128, 256}: no padding — Mosaic tiles 64-lane minors natively,
-    # and padding d doubles every dot and all q/k/v traffic (measured 2x)
-    dpad = 0 if d in (64, 128, 256) else (128 - d % 128) % 128
-    # [B,S,H,D] -> [B*H, S, D], zero-padded to tile multiples
-    def to_bh(x, s, spad):
-        x = jnp.swapaxes(x, 1, 2).reshape(b * h, s, d)
-        if spad or dpad:
-            x = jnp.pad(x, ((0, 0), (0, spad), (0, dpad)))
-        return x
-
-    qb, kb, vb = to_bh(q, sq, qpad), to_bh(k, sk, kpad), to_bh(v, sk, kpad)
     out = _flash_bhsd(qb, kb, vb, scale, causal, kv_valid, block_q, block_k)
     if qpad or dpad:
         out = out[:, :sq, :d]
     return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2)
+
+
+def flash_attention_with_lse(q, k, v, causal=True, scale=None,
+                             q_positions=None, kv_positions=None,
+                             block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """Flash attention on [B, S, H, D] returning ``(out, lse)`` where ``lse``
+    is [B, H, Sq] float32 log-sum-exp of the scaled logits — the statistic
+    blockwise/ring attention needs to merge partial results, and whose
+    cotangent flows back through the same Pallas bwd kernels.
+
+    ``q_positions`` / ``kv_positions`` ([Sq] / [Sk] int arrays): global token
+    index of each position. When given, the mask is ``q_pos >= kv_pos``
+    (position-driven causality — supports zig-zag ring layouts) and
+    ``causal`` is ignored. Rows with no attendable key get out=0 and
+    lse ≈ -1e30 (≈ -inf), which :func:`jnp.logaddexp`-style merges treat
+    correctly.
+    """
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qb, kb, vb, block_q, block_k, qpad, kpad, dpad = _prep_bhsd(
+        q, k, v, block_q, block_k)
+
+    pos_mask = q_positions is not None
+    if pos_mask:
+        if kv_positions is None:
+            raise ValueError("q_positions given without kv_positions")
+        # sentinels make padded q rows fully masked and padded kv cols
+        # never attended; kv_valid is then unnecessary
+        qp = jnp.pad(q_positions.astype(jnp.float32), (0, qpad),
+                     constant_values=-2.0 ** 30)[:, None]  # [sq_p, 1]
+        kp = jnp.pad(kv_positions.astype(jnp.float32), (0, kpad),
+                     constant_values=2.0 ** 30)[None, :]  # [1, sk_p]
+        kv_valid, causal = None, False
+    else:
+        qp = kp = None
+        kv_valid = sk if kpad else None
+
+    out, lse = _flash_bhsd_lse(qb, kb, vb, qp, kp, scale, causal, kv_valid,
+                               block_q, block_k)
+    if qpad or dpad:
+        out = out[:, :sq, :d]
+    lse = lse[:, :sq, 0].reshape(b, h, sq)
+    return jnp.swapaxes(out.reshape(b, h, sq, d), 1, 2), lse

@@ -94,6 +94,51 @@ class TestRingAttention:
                                        atol=3e-4)
 
 
+class TestRingFlashVsXla:
+    """VERDICT r1 #4: the ring's inner block attend is the Pallas flash
+    kernel (joint (out, lse) custom_vjp). The "xla" impl (materialized
+    logits) is kept as the reference — both must agree fwd + bwd."""
+
+    def test_forward_equivalence(self, qkv, sep_mesh):
+        q, k, v = qkv
+        o_flash = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh=sep_mesh, causal=True, impl="flash"))(q, k, v)
+        o_xla = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh=sep_mesh, causal=True, impl="xla"))(q, k, v)
+        np.testing.assert_allclose(np.asarray(o_flash), np.asarray(o_xla),
+                                   atol=2e-5)
+
+    def test_zigzag_grads_equivalence(self, qkv, sep_mesh):
+        q, k, v = qkv
+        perm = zigzag_indices(S, 4)
+        pos = jnp.asarray(perm, jnp.int32)
+
+        def loss(impl):
+            def f(q, k, v):
+                out = ring_attention(
+                    q[:, perm], k[:, perm], v[:, perm], mesh=sep_mesh,
+                    causal=True, q_positions=pos, kv_positions=pos, impl=impl,
+                )
+                return jnp.sum(out ** 2)
+            return f
+
+        g_flash = jax.jit(jax.grad(loss("flash"), argnums=(0, 1, 2)))(q, k, v)
+        g_xla = jax.jit(jax.grad(loss("xla"), argnums=(0, 1, 2)))(q, k, v)
+        for gf, gx in zip(g_flash, g_xla):
+            np.testing.assert_allclose(np.asarray(gf), np.asarray(gx),
+                                       atol=3e-4)
+
+    def test_bf16_inputs(self, qkv, sep_mesh):
+        q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+        out = jax.jit(lambda q, k, v: ring_attention(
+            q, k, v, mesh=sep_mesh, causal=True))(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        ref = full_attention(*(x.astype(jnp.float32) for x in (q, k, v)),
+                             causal=True)
+        np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                                   np.asarray(ref), atol=3e-2)
+
+
 class TestUlysses:
     def test_full_bidirectional(self, qkv, sep_mesh):
         q, k, v = qkv

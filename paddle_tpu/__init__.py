@@ -136,6 +136,13 @@ def summary(net, input_size=None, dtypes=None):
 
 
 # top-level aliases resolved from submodules (paddle exports these at root)
-from .ops.linalg import cross, histogram, norm  # noqa: F401,E402
+from .ops.linalg import (  # noqa: F401,E402
+    cross,
+    histogram,
+    histogramdd,
+    mv,
+    norm,
+    tensordot,
+)
 from .nn.functional.activation import log_softmax  # noqa: F401,E402
 from .ops.math import bincount, einsum, nonzero, unique  # noqa: F401,E402

@@ -7,18 +7,25 @@ Two regimes, matching the plan in SURVEY.md:
   These are what TP/DP/PP layers use under ``shard_map``/pjit; XLA schedules
   them onto ICI with async start/done pairs (replacing the reference's
   per-group NCCL comm streams + events, process_group_nccl.cc).
-* **eager** (control plane / API compat): host-mediated collectives over the
-  jax.distributed coordination service via ``multihost_utils`` when running
-  multi-process; identity when world_size == 1. Used for init broadcast,
-  found_inf reduction, metrics — never in the step hot loop.
+* **eager**: per-group COMPILED device collectives (VERDICT r1 #7). Each
+  Group gets a submesh of exactly its member processes' devices; members
+  build a global array from their local shard and run a cached one-op jitted
+  program whose data moves device-to-device (ICI/DCN) — matching
+  process_group_nccl.cc's per-group-communicator semantics. Non-member
+  processes DO NOT participate (no all-world gather, no host round-trip).
+  Pairwise ``send``/``recv`` ride a 2-device submesh the same way (both
+  sides post, like NCCL p2p). Object collectives (pickle payloads) stay on
+  the coordination service — control plane, not tensor data.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..framework.tensor import Tensor
 from .topology import Group
@@ -59,31 +66,85 @@ def _unwrap(t):
     return t._data if isinstance(t, Tensor) else jnp.asarray(t)
 
 
-def _gather_stack(arr, group: Group):
-    """All ranks' arrays stacked on axis 0 (multi-process path)."""
-    from jax.experimental import multihost_utils
+# ----------------------------------------------------- per-group submesh
 
-    # coordination-service allgather over ALL processes, then select group
-    gathered = multihost_utils.process_allgather(np.asarray(jax.device_get(arr)))
-    return gathered[np.asarray(group.ranks)]
+_REDUCERS = {
+    ReduceOp.SUM: lambda x: jnp.sum(x, axis=0),
+    ReduceOp.MAX: lambda x: jnp.max(x, axis=0),
+    ReduceOp.MIN: lambda x: jnp.min(x, axis=0),
+    ReduceOp.PROD: lambda x: jnp.prod(x, axis=0),
+    ReduceOp.AVG: lambda x: jnp.mean(x, axis=0),
+}
+
+
+@functools.lru_cache(maxsize=None)
+def _group_mesh(ranks: tuple):
+    """1-D mesh over ONE device per member process (rank == process_index,
+    the init_parallel_env contract). Only these devices move data."""
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    try:
+        devs = [per_proc[r] for r in ranks]
+    except KeyError as e:
+        raise RuntimeError(
+            f"group rank {e} has no jax device (process not initialized?)"
+        ) from e
+    return jax.sharding.Mesh(np.asarray(devs), ("g",))
+
+
+def _global_from_local(arr, mesh):
+    """Stack each member's local array on a new leading 'g'-sharded axis."""
+    arr = jnp.asarray(arr)
+    sharding = NamedSharding(mesh, P("g"))
+    pid = jax.process_index()
+    mine = next(d for d in mesh.devices.flat if d.process_index == pid)
+    shard = jax.device_put(arr[None], mine)
+    return jax.make_array_from_single_device_arrays(
+        (mesh.size,) + arr.shape, sharding, [shard])
+
+
+@functools.lru_cache(maxsize=512)
+def _group_prog(mesh, kind: str, extra, shape, dtype):
+    """One compiled per-group collective. ``kind``/``extra``:
+    reduce/op, gather/None, select/src_index (broadcast & p2p),
+    scatter/src_index, alltoall/None, reduce_scatter/op."""
+    if kind == "reduce":
+        fn, out_spec = _REDUCERS[extra], P()
+    elif kind == "gather":
+        fn, out_spec = (lambda x: x), P()
+    elif kind == "select":
+        fn, out_spec = (lambda x: x[extra]), P()
+    elif kind == "scatter":
+        fn, out_spec = (lambda x: x[extra]), P("g")
+    elif kind == "alltoall":
+        fn, out_spec = (lambda x: jnp.swapaxes(x, 0, 1)), P("g")
+    elif kind == "reduce_scatter":
+        fn, out_spec = (lambda x: _REDUCERS[extra](x)), P("g")
+    else:  # pragma: no cover
+        raise ValueError(kind)
+    return jax.jit(fn, in_shardings=NamedSharding(mesh, P("g")),
+                   out_shardings=NamedSharding(mesh, out_spec))
+
+
+def _run_group(arr, group: Group, kind: str, extra=None):
+    """Build the group submesh, run the cached program, return this
+    member's addressable result as a jnp array."""
+    mesh = _group_mesh(tuple(group.ranks))
+    g = _global_from_local(arr, mesh)
+    out = _group_prog(mesh, kind, extra, g.shape, g.dtype.name)(g)
+    return jnp.asarray(out.addressable_shards[0].data)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None, sync_op=True):
     """In-place eager allreduce (reference: paddle.distributed.all_reduce,
-    python/paddle/distributed/communication/all_reduce.py)."""
+    python/paddle/distributed/communication/all_reduce.py). Group ops are
+    collective over the GROUP's processes only; non-members return
+    immediately (process_group_nccl.cc per-group-comm semantics)."""
     group = _group_or_world(group)
-    if group.nranks <= 1 or _world().world_size <= 1:
+    if group.nranks <= 1 or _world().world_size <= 1 or not _is_member(group):
         return tensor
-    # process_allgather is a collective over ALL processes — non-members must
-    # still participate (then discard) or member ranks deadlock waiting
-    stacked = _gather_stack(_unwrap(tensor), group)
-    if not _is_member(group):
-        return tensor
-    red = {
-        ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
-        ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
-    }[op](stacked, axis=0)
-    out = jnp.asarray(red)
+    out = _run_group(_unwrap(tensor), group, "reduce", op)
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -95,8 +156,10 @@ def all_gather(tensor_list, tensor, group: Optional[Group] = None, sync_op=True)
     arr = _unwrap(tensor)
     if group.nranks <= 1 or _world().world_size <= 1:
         parts = [arr]
+    elif not _is_member(group):
+        return tensor_list
     else:
-        parts = list(_gather_stack(arr, group))
+        parts = list(_run_group(arr, group, "gather"))
     for p in parts:
         tensor_list.append(Tensor._wrap(jnp.asarray(p)))
     return tensor_list
@@ -131,10 +194,10 @@ def broadcast(tensor, src: int, group: Optional[Group] = None, sync_op=True):
         raise ValueError(
             f"broadcast src rank {src} is not a member of group {group.ranks}"
         )
-    stacked = _gather_stack(_unwrap(tensor), group)  # all-process collective
     if not _is_member(group):
         return tensor
-    out = jnp.asarray(stacked[group.get_group_rank(src)])
+    out = _run_group(_unwrap(tensor), group, "select",
+                     group.get_group_rank(src))
     if isinstance(tensor, Tensor):
         tensor._data = out
         return tensor
@@ -157,12 +220,19 @@ def scatter(tensor, tensor_list=None, src: int = 0, group: Optional[Group] = Non
             src_val = tensor_list[0]
             tensor._data = _unwrap(src_val)
         return tensor
-    # src rank contributes the list; others receive their slice
-    obj = [np.asarray(jax.device_get(_unwrap(t))) for t in (tensor_list or [])]
-    gathered: list = []
-    all_gather_object(gathered, obj, group=Group(group.ranks, rank=group.rank))
-    src_objs = gathered[group.get_group_rank(src)]
-    tensor._data = jnp.asarray(src_objs[group.rank])
+    if not _is_member(group):
+        return tensor
+    # every member contributes [G, ...]: src its stacked list, others a
+    # same-shaped placeholder matched from their recv buffer
+    if group.get_group_rank(src) == group.get_group_rank(env.rank):
+        if len(tensor_list or []) != group.nranks:
+            raise ValueError("scatter: src needs one tensor per group rank")
+        stacked = jnp.stack([_unwrap(t) for t in tensor_list])
+    else:
+        base = _unwrap(tensor)
+        stacked = jnp.zeros((group.nranks,) + base.shape, base.dtype)
+    out = _run_group(stacked, group, "scatter", group.get_group_rank(src))
+    tensor._data = out[0]
     return tensor
 
 
@@ -173,14 +243,12 @@ def all_to_all(out_tensor_list, in_tensor_list, group: Optional[Group] = None,
     if group.nranks <= 1 or env.world_size <= 1:
         out_tensor_list.extend(in_tensor_list)
         return out_tensor_list
-    objs: list = []
-    all_gather_object(
-        objs, [np.asarray(jax.device_get(_unwrap(t))) for t in in_tensor_list],
-        group=group,
-    )
-    me = group.rank
+    if not _is_member(group):
+        return out_tensor_list
+    stacked = jnp.stack([_unwrap(t) for t in in_tensor_list])  # [G, ...]
+    out = _run_group(stacked, group, "alltoall")[0]  # [G, ...] received
     for r in range(group.nranks):
-        out_tensor_list.append(Tensor._wrap(jnp.asarray(objs[r][me])))
+        out_tensor_list.append(Tensor._wrap(out[r]))
     return out_tensor_list
 
 
@@ -191,18 +259,10 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM,
     if group.nranks <= 1 or env.world_size <= 1:
         tensor._data = _unwrap(tensor_list[0])
         return tensor
-    objs: list = []
-    all_gather_object(
-        objs, [np.asarray(jax.device_get(_unwrap(t))) for t in tensor_list],
-        group=group,
-    )
-    me = group.rank
-    parts = np.stack([objs[r][me] for r in range(group.nranks)])
-    red = {
-        ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max, ReduceOp.MIN: np.min,
-        ReduceOp.PROD: np.prod, ReduceOp.AVG: np.mean,
-    }[op](parts, axis=0)
-    tensor._data = jnp.asarray(red)
+    if not _is_member(group):
+        return tensor
+    stacked = jnp.stack([_unwrap(t) for t in tensor_list])  # [G, ...]
+    tensor._data = _run_group(stacked, group, "reduce_scatter", op)[0]
     return tensor
 
 
@@ -215,18 +275,29 @@ def barrier(group: Optional[Group] = None):
 
 
 def send(tensor, dst: int, group: Optional[Group] = None, sync_op=True):
-    raise NotImplementedError(
-        "eager p2p send/recv is not part of the TPU execution model; pipeline "
-        "communication is compiled (lax.ppermute over the 'pp' mesh axis — "
-        "see paddle_tpu.distributed.fleet.meta_parallel pipeline engine)"
-    )
+    """Pairwise p2p: a 2-device submesh program between exactly (me, dst) —
+    no other process participates (reference: process_group_nccl.cc Send,
+    per-pair communicator). Both sides must post (send ↔ recv), matching
+    NCCL p2p semantics."""
+    env = _world()
+    if env.world_size <= 1:
+        return tensor
+    pair = Group([env.rank, dst], rank=env.rank)
+    _run_group(_unwrap(tensor), pair, "select", 0)
+    return tensor
 
 
 def recv(tensor, src: int, group: Optional[Group] = None, sync_op=True):
-    raise NotImplementedError(
-        "eager p2p send/recv is not part of the TPU execution model; pipeline "
-        "communication is compiled (lax.ppermute over the 'pp' mesh axis)"
-    )
+    """Pairwise p2p receive; see :func:`send`."""
+    env = _world()
+    if env.world_size <= 1:
+        return tensor
+    pair = Group([src, env.rank], rank=env.rank)
+    out = _run_group(_unwrap(tensor), pair, "select", 0)
+    if isinstance(tensor, Tensor):
+        tensor._data = out
+        return tensor
+    return out
 
 
 class fcollectives:

@@ -105,12 +105,18 @@ class ElasticSupervisor:
 
     def __init__(self, cmd_builder, world_size: int,
                  endpoints: Sequence[str], max_restarts: int = 3,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 compile_cache_dir: Optional[str] = None):
         self.cmd_builder = cmd_builder  # rank -> argv list
         self.world_size = world_size
         self.endpoints = list(endpoints)
         self.max_restarts = max_restarts
         self.log_dir = log_dir
+        # persistent XLA compilation cache shared across restarts (restart
+        # goodput, SURVEY.md §7 hard part 6): defaults next to the logs
+        if compile_cache_dir is None and log_dir:
+            compile_cache_dir = os.path.join(log_dir, "xla_cache")
+        self.compile_cache_dir = compile_cache_dir
         self.restarts = 0
 
     def _spawn_world(self) -> Watcher:
@@ -118,6 +124,8 @@ class ElasticSupervisor:
         files = []
         for rank in range(self.world_size):
             env = build_env(rank, self.world_size, self.endpoints)
+            if self.compile_cache_dir:
+                env["PADDLE_COMPILATION_CACHE_DIR"] = self.compile_cache_dir
             stdout = stderr = None
             if self.log_dir:
                 os.makedirs(self.log_dir, exist_ok=True)

@@ -48,6 +48,12 @@ def _parse(argv: Optional[Sequence[str]] = None):
     p.add_argument("--log_dir", type=str, default="log")
     p.add_argument("--elastic", type=int, default=0,
                    help="max whole-world restarts on worker failure")
+    p.add_argument("--elastic_master", type=str, default="",
+                   help="http://host:port of the rendezvous master "
+                        "(multi-node elastic membership)")
+    p.add_argument("--node_endpoint", type=str, default="",
+                   help="this node's advertised host:base_port "
+                        "(with --elastic_master)")
     p.add_argument("--run_mode", type=str, default="collective")
     p.add_argument("script", type=str)
     p.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -129,8 +135,96 @@ def launch(script: str, script_args: Sequence[str] = (),
     return watcher.wait()
 
 
+def launch_with_master(script: str, script_args: Sequence[str] = (),
+                       master_url: str = "", node_endpoint: str = "",
+                       nproc_per_node: int = 1, log_dir: Optional[str] = "log",
+                       max_restarts: int = 3, devices: str = "",
+                       poll_interval: float = 0.5) -> int:
+    """Agent-driven multi-node elastic launch (reference: ElasticManager's
+    watch loop over etcd membership + controllers/master.py).
+
+    Registers this node with the HTTP master, waits for the world to be
+    ready, spawns the local workers, then watches BOTH the local processes
+    and the membership epoch. A worker failure or an epoch change (node died
+    elsewhere / node joined) tears the local world down and relaunches under
+    the new assignment; scripts resume from their checkpoints."""
+    import subprocess
+    import time as _time
+
+    from .master import NodeAgent
+
+    if not node_endpoint:
+        node_endpoint = f"{socket.gethostbyname(socket.gethostname())}:" \
+                        f"{_free_port()}"
+    host, base_port = node_endpoint.rsplit(":", 1)
+    base_port = int(base_port)
+    agent = NodeAgent(master_url, node_id=node_endpoint,
+                      endpoint=node_endpoint).start()
+    restarts = 0
+    code = 1
+    try:
+        while True:
+            node_rank, world_nodes, epoch = agent.wait_ready()
+            nnodes = len(world_nodes)
+            world_size = nnodes * nproc_per_node
+            all_eps: List[str] = []
+            for ep in world_nodes:
+                h, p0 = ep.rsplit(":", 1)
+                all_eps += [f"{h}:{int(p0) + l}"
+                            for l in range(nproc_per_node)]
+            procs, files = [], []
+            for local in range(nproc_per_node):
+                rank = node_rank * nproc_per_node + local
+                env = build_env(rank, world_size, all_eps)
+                env["PADDLE_ELASTIC_EPOCH"] = str(epoch)
+                if devices:
+                    env["JAX_VISIBLE_DEVICES"] = devices
+                stdout = stderr = None
+                if log_dir:
+                    os.makedirs(log_dir, exist_ok=True)
+                    f = open(os.path.join(log_dir, f"workerlog.{rank}"),
+                             "ab")
+                    files.append(f)
+                    stdout = stderr = f
+                procs.append(subprocess.Popen(
+                    [sys.executable, script, *script_args], env=env,
+                    stdout=stdout, stderr=stderr))
+            watcher = Watcher(procs, owned_files=files)
+            reason = None
+            while reason is None:
+                code = watcher.poll()
+                if code == 0:
+                    agent.stop()
+                    watcher.close_files()
+                    return 0
+                if code is not None:
+                    reason = f"local worker failed (exit {code})"
+                elif agent.epoch_changed(epoch):
+                    reason = "membership epoch changed"
+                else:
+                    _time.sleep(poll_interval)
+            watcher.kill_all()
+            watcher.close_files()
+            restarts += 1
+            if restarts > max_restarts:
+                print(f"[elastic] giving up after {restarts - 1} restarts "
+                      f"({reason})", file=sys.stderr)
+                return code if isinstance(code, int) and code else 1
+            print(f"[elastic] {reason}; relaunching "
+                  f"(attempt {restarts}/{max_restarts})", file=sys.stderr)
+    finally:
+        agent.stop()
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = _parse(argv)
+    if args.elastic_master:
+        return launch_with_master(
+            args.script, args.script_args, master_url=args.elastic_master,
+            node_endpoint=args.node_endpoint,
+            nproc_per_node=args.nproc_per_node, log_dir=args.log_dir,
+            max_restarts=args.elastic, devices=args.devices,
+        )
     return launch(
         args.script, args.script_args, nproc_per_node=args.nproc_per_node,
         nnodes=args.nnodes, node_rank=args.node_rank, master=args.master,

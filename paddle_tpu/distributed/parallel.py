@@ -59,6 +59,11 @@ def init_parallel_env(strategy=None):
     global _default_group
     if _env.initialized:
         return _default_group
+    # restart goodput: workers (re)spawned by the elastic supervisor carry
+    # PADDLE_COMPILATION_CACHE_DIR so recompiles after a failure are disk hits
+    from ..framework.compile_cache import maybe_enable_from_env
+
+    maybe_enable_from_env()
     if _env.world_size > 1 and not jax.distributed.is_initialized():
         coordinator = _env.master or _env.trainer_endpoints[0]
         jax.distributed.initialize(

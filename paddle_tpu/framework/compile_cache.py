@@ -1,0 +1,53 @@
+"""Persistent XLA compilation cache (SURVEY.md §7 hard part 6: restart
+goodput — a restarted worker must not pay the multi-minute XLA compile for a
+program it already compiled before the failure).
+
+The reference has no equivalent (CUDA kernels are precompiled; its restart
+cost is NCCL re-init). On TPU the compile IS the restart cost, so the cache
+is wired into the elastic path: ``ElasticSupervisor`` exports
+``PADDLE_COMPILATION_CACHE_DIR`` to every (re)spawned worker and
+``init_parallel_env`` picks it up.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+ENV_VAR = "PADDLE_COMPILATION_CACHE_DIR"
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` (default:
+    $PADDLE_COMPILATION_CACHE_DIR or ~/.cache/paddle_tpu/xla). Thresholds are
+    lowered so even small programs are cached — restart goodput beats the
+    few MB of disk. Idempotent; returns the directory."""
+    global _enabled_dir
+    import jax
+
+    cache_dir = (cache_dir or os.environ.get(ENV_VAR)
+                 or os.path.join(os.path.expanduser("~"), ".cache",
+                                 "paddle_tpu", "xla"))
+    cache_dir = os.path.abspath(cache_dir)
+    if _enabled_dir == cache_dir:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled_dir = cache_dir
+    return cache_dir
+
+
+def compilation_cache_dir() -> Optional[str]:
+    """The active cache directory, or None when not enabled."""
+    return _enabled_dir
+
+
+def maybe_enable_from_env() -> Optional[str]:
+    """Enable iff PADDLE_COMPILATION_CACHE_DIR is set (the elastic
+    supervisor's contract with restarted workers)."""
+    if os.environ.get(ENV_VAR):
+        return enable_compilation_cache()
+    return None

@@ -9,7 +9,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler", "VisualDL",
            "EarlyStopping", "CallbackList"]
 
 
@@ -169,3 +169,65 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait > self.patience:
                 self.model.stop_training = True
+
+
+class VisualDL(Callback):
+    """Scalar-summary writer callback (reference: hapi/callbacks.py VisualDL
+    over the visualdl LogWriter). TPU build logs through TensorBoard's event
+    format when available (torch.utils.tensorboard ships in this image) and
+    falls back to JSONL files with the same API, so dashboards and plain
+    tooling both work."""
+
+    def __init__(self, log_dir: str = "./log"):
+        self.log_dir = log_dir
+        self._writer = None
+        self._jsonl = None
+        self._global_step = 0
+
+    def _ensure_writer(self):
+        if self._writer is not None or self._jsonl is not None:
+            return
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            self._writer = SummaryWriter(log_dir=self.log_dir)
+        except Exception:
+            self._jsonl = open(
+                os.path.join(self.log_dir, "scalars.jsonl"), "a")
+
+    def _scalar(self, tag, value, step):
+        try:
+            value = float(value)
+        except (TypeError, ValueError):
+            return
+        self._ensure_writer()
+        if self._writer is not None:
+            self._writer.add_scalar(tag, value, step)
+        else:
+            import json
+
+            self._jsonl.write(json.dumps(
+                {"tag": tag, "value": value, "step": step}) + "\n")
+            self._jsonl.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._global_step += 1
+        for k, v in (logs or {}).items():
+            self._scalar(f"train/{k}", v, self._global_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        for k, v in (logs or {}).items():
+            self._scalar(f"train_epoch/{k}", v, epoch)
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            self._scalar(f"eval/{k}", v, self._global_step)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+        if self._jsonl is not None:
+            self._jsonl.close()

@@ -308,8 +308,23 @@ class FusedMultiTransformer(nn.Layer):
             return F.flash_attention(q, k, v, causal=True,
                                      training=self.training)[0]
 
+        from ....ops.pallas.paged_attention import PagedKVCache
+
         if cache is None:
             out = ctx_attention()
+        elif isinstance(cache, PagedKVCache):
+            # paged/block cache (serving path): the manager mutates host-side
+            # block tables and functional page arrays; inference-only (no
+            # tape node — gradients don't flow through a serving cache)
+            unwrap = lambda t: t._data if isinstance(t, Tensor) else t
+            qd, kd, vd = unwrap(q), unwrap(k), unwrap(v)
+            if time_step is None:
+                cache.prefill(kd, vd)  # [b, s, nh, hd]
+                out = ctx_attention()
+            else:
+                cache.append(kd[:, 0], vd[:, 0])
+                out = Tensor._wrap(cache.attend(qd[:, 0])[:, None])
+            new_cache = cache
         elif time_step is None:
             # context phase: write prompt k/v at positions [0, s)
             from ....ops.pallas.decode_attention import cache_prefill_write

@@ -1,7 +1,9 @@
 """Linear-algebra ops (reference: python/paddle/tensor/linalg.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.tensor import Tensor, apply_op
 
@@ -102,3 +104,151 @@ def histogram(x, bins=100, min=0, max=0):
     lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
     h, _ = jnp.histogram(arr, bins=bins, range=(lo, hi))
     return Tensor._wrap(h)
+
+
+# ---- linalg long tail (reference: python/paddle/tensor/linalg.py; VERDICT
+# r1 #10 — each checked against a numpy/scipy reference in
+# tests/test_op_longtail.py)
+
+
+def lu(x, pivot=True, get_infos=False):
+    """LU factorization; returns (LU, pivots[, infos]) with 1-based pivots
+    (reference convention: paddle.linalg.lu)."""
+    if not pivot:
+        raise NotImplementedError("lu(pivot=False) is not supported on TPU")
+    import jax.scipy.linalg as jsl
+
+    # single factorization in the common (no-grad) path; when the input is
+    # being differentiated, the LU matrix goes through apply_op for its VJP
+    # and only then is the factorization evaluated a second time for the
+    # integral pivots
+    from ..framework.tensor import is_grad_enabled
+
+    xt = _t(x)
+    if isinstance(x, Tensor) and not x.stop_gradient and is_grad_enabled():
+        lu_m = apply_op(lambda a: jsl.lu_factor(a)[0], xt)
+        piv_raw = jsl.lu_factor(xt._data)[1]
+    else:
+        raw_lu, piv_raw = jsl.lu_factor(xt._data)
+        lu_m = Tensor._wrap(raw_lu, stop_gradient=True)
+    piv = Tensor(piv_raw.astype(jnp.int32) + 1)
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2] or (1,), jnp.int32))
+        return lu_m, piv, info
+    return lu_m, piv
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True):
+    """Unpack paddle.linalg.lu output into (P, L, U); skipped parts return
+    None (reference: paddle.linalg.lu_unpack flags)."""
+    lu_arr = _t(x)._data if isinstance(x, Tensor) else jnp.asarray(x)
+    n = lu_arr.shape[-2]
+
+    def perm_mat(piv):
+        perm = jnp.arange(n)
+
+        def body(i, p):
+            j = piv[i] - 1  # back to 0-based
+            pi, pj = p[i], p[j]
+            return p.at[i].set(pj).at[j].set(pi)
+
+        perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+        return jnp.eye(n, dtype=lu_arr.dtype)[perm]
+
+    L = U = P = None
+    if unpack_ludata:
+        L = apply_op(lambda a: jnp.tril(a, -1) + jnp.eye(
+            a.shape[-2], a.shape[-1], dtype=a.dtype), _t(x))
+        U = apply_op(jnp.triu, _t(x))
+    if unpack_pivots:
+        P = apply_op(lambda p: perm_mat(p), _t(y))
+    return P, L, U
+
+
+def logdet(x):
+    def fn(a):
+        sign, ld = jnp.linalg.slogdet(a)
+        return jnp.where(sign <= 0, jnp.nan, ld)
+
+    return apply_op(fn, _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    def fn(a):
+        s = (jnp.abs(jnp.linalg.eigvalsh(a)) if hermitian
+             else jnp.linalg.svd(a, compute_uv=False))
+        cutoff = tol if tol is not None else (
+            jnp.max(s, axis=-1, keepdims=True)
+            * max(a.shape[-2], a.shape[-1])
+            * jnp.finfo(a.dtype).eps)
+        return jnp.sum(s > cutoff, axis=-1).astype(jnp.int32)
+
+    return apply_op(fn, _t(x))
+
+
+def eigvalsh(x, UPLO="L"):
+    return apply_op(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x))
+
+
+def eig(x):
+    """General (complex) eigendecomposition — CPU-only in XLA; evaluated on
+    host (reference: paddle.linalg.eig is CPU-only too)."""
+    import numpy as _np
+
+    a = _np.asarray(jax.device_get(_t(x)._data))
+    w, v = _np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x):
+    import numpy as _np
+
+    a = _np.asarray(jax.device_get(_t(x)._data))
+    return Tensor(jnp.asarray(_np.linalg.eigvals(a)))
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A @ out = x given y = cholesky factor of A (reference:
+    paddle.linalg.cholesky_solve)."""
+    import jax.scipy.linalg as jsl
+
+    return apply_op(
+        lambda b, c: jsl.cho_solve((c, not upper), b), _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    """Solve x @ out = y with triangular x (reference:
+    paddle.linalg.triangular_solve)."""
+    import jax.scipy.linalg as jsl
+
+    return apply_op(
+        lambda a, b: jsl.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular),
+        _t(x), _t(y))
+
+
+def mv(x, vec):
+    return apply_op(lambda a, v: jnp.einsum("...ij,...j->...i", a, v),
+                    _t(x), _t(vec))
+
+
+def tensordot(x, y, axes=2):
+    if isinstance(axes, Tensor):
+        axes = np.asarray(axes._data).tolist()
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), _t(x), _t(y))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    """Returns (hist, edges_list) like paddle.histogramdd. Counting is
+    piecewise-constant, so no gradient path (matches the reference, which
+    has no histogram grad kernel)."""
+    h, edges = jnp.histogramdd(
+        _t(x)._data, bins=bins, range=ranges, density=density,
+        weights=None if weights is None else _t(weights)._data)
+    return Tensor._wrap(h, stop_gradient=True), [Tensor(e) for e in edges]
+
+
+__all__ += ["lu", "lu_unpack", "logdet", "matrix_rank", "eigvalsh", "eig",
+            "eigvals", "cholesky_solve", "triangular_solve", "mv",
+            "tensordot", "histogramdd"]

@@ -1,0 +1,675 @@
+"""Tensor-API long tail (reference: python/paddle/tensor/{math,manipulation,
+search,stat,logic}.py — VERDICT r1 #10: the next ~100 most-used functions,
+each with an OpTest-style numpy check in tests/test_op_longtail.py).
+
+Same contract as the sibling op modules: accept Tensors or array-likes,
+route through apply_op so eager autograd records VJPs, trace cleanly under
+jit. Ops whose output shape depends on data (unique_consecutive) evaluate
+eagerly on host, like their reference counterparts' dynamic-shape kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    # masking / indexing
+    "masked_fill", "masked_scatter", "index_sample", "index_add",
+    "index_put", "take", "select_scatter", "slice_scatter", "scatter_nd",
+    "scatter_nd_add",
+    # scans / search
+    "cummax", "cummin", "logcumsumexp", "searchsorted", "bucketize",
+    "kthvalue", "mode", "median", "nanmedian", "quantile", "nanquantile",
+    # reductions / numerics
+    "amax", "amin", "nanmean", "nansum", "count_nonzero", "logaddexp",
+    "trapezoid", "cumulative_trapezoid", "renorm",
+    # elementwise
+    "trunc", "frac", "frac_", "fmod", "fmax", "fmin", "neg", "signbit",
+    "heaviside", "copysign", "hypot", "nextafter", "ldexp", "frexp",
+    "gcd", "lcm", "float_power", "erfinv", "lgamma", "digamma",
+    "polygamma", "i0", "i0e", "i1", "i1e", "sinc", "xlogy",
+    # complex
+    "angle", "real", "imag", "conj", "isreal", "polar", "as_complex",
+    "as_real",
+    # bitwise
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+    # layout / shape
+    "rot90", "unfold", "vsplit", "hsplit", "dsplit", "tensor_split",
+    "diagflat", "diagonal", "diag_embed", "tril_indices", "triu_indices",
+    "vander", "logspace",
+    # matrix-ish composites
+    "addmv", "baddbmm",
+    # logic / dedup
+    "equal_all", "unique_consecutive",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _u(fn, x, **kw):
+    return apply_op(lambda a: fn(a, **kw), _t(x))
+
+
+def _b(fn, x, y):
+    if isinstance(y, Tensor) or isinstance(x, Tensor):
+        return apply_op(fn, _t(x), _t(y))
+    return apply_op(lambda a: fn(a, jnp.asarray(y)), _t(x))
+
+
+# ------------------------------------------------------- masking / indexing
+
+
+def masked_fill(x, mask, value):
+    m = _t(mask)
+    v = value._data if isinstance(value, Tensor) else value
+    return apply_op(lambda a, mm: jnp.where(mm, v, a), _t(x), m)
+
+
+def masked_scatter(x, mask, value):
+    """Fill True positions of ``mask`` with consecutive elements of
+    ``value`` (row-major), reference paddle.masked_scatter."""
+
+    def fn(a, mm, v):
+        mm = jnp.broadcast_to(mm, a.shape)
+        pos = jnp.cumsum(mm.reshape(-1)) - 1
+        src = v.reshape(-1)[jnp.clip(pos, 0, v.size - 1)].reshape(a.shape)
+        return jnp.where(mm, src.astype(a.dtype), a)
+
+    return apply_op(fn, _t(x), _t(mask), _t(value))
+
+
+def index_sample(x, index):
+    """Per-row gather: x [N, C], index [N, K] → [N, K] (reference:
+    paddle.index_sample)."""
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=1),
+                    _t(x), _t(index))
+
+
+def index_add(x, index, axis, value):
+    def fn(a, i, v):
+        am = jnp.moveaxis(a, axis, 0)
+        vm = jnp.moveaxis(v, axis, 0)
+        return jnp.moveaxis(am.at[i].add(vm), 0, axis)
+
+    return apply_op(fn, _t(x), _t(index), _t(value))
+
+
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(_t(i)._data for i in indices)
+
+    def fn(a, v):
+        ref = a.at[idx]
+        return ref.add(v) if accumulate else ref.set(
+            jnp.broadcast_to(v, a[idx].shape).astype(a.dtype))
+
+    return apply_op(fn, _t(x), _t(value))
+
+
+def take(x, index, mode="raise"):
+    """Flattened-index take. 'raise' degrades to 'clip' (no data-dependent
+    errors inside compiled programs); 'wrap'/'clip' per reference."""
+    jmode = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply_op(
+        lambda a, i: jnp.take(a.reshape(-1), i, mode=jmode), _t(x), _t(index))
+
+
+def select_scatter(x, values, axis, index):
+    def fn(a, v):
+        am = jnp.moveaxis(a, axis, 0)
+        return jnp.moveaxis(am.at[index].set(v.astype(a.dtype)), 0, axis)
+
+    return apply_op(fn, _t(x), _t(values))
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    def fn(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+
+    return apply_op(fn, _t(x), _t(value))
+
+
+def scatter_nd(index, updates, shape):
+    """Zeros of ``shape`` with ``updates`` summed in at ``index`` (duplicate
+    indices accumulate — reference paddle.scatter_nd)."""
+
+    def fn(i, u):
+        out = jnp.zeros(tuple(shape), u.dtype)
+        return out.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op(fn, _t(index), _t(updates))
+
+
+def scatter_nd_add(x, index, updates):
+    return apply_op(
+        lambda a, i, u: a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u),
+        _t(x), _t(index), _t(updates))
+
+
+# ----------------------------------------------------------- scans / search
+
+
+def _cum_extreme(x, axis, is_max):
+    def fn(a):
+        ax = a.ndim - 1 if axis is None else axis % a.ndim
+        idx = jax.lax.broadcasted_iota(jnp.int32, a.shape, ax)
+
+        def comb(l, r):
+            lv, li = l
+            rv, ri = r
+            cond = (rv > lv) if is_max else (rv < lv)
+            return jnp.where(cond, rv, lv), jnp.where(cond, ri, li)
+
+        return jax.lax.associative_scan(comb, (a, idx), axis=ax)
+
+    vals = apply_op(lambda a: fn(a)[0], _t(x))
+    idxs = Tensor(fn(_t(x)._data)[1])
+    return vals, idxs
+
+
+def cummax(x, axis=None, dtype="int64"):
+    return _cum_extreme(x, axis, True)
+
+
+def cummin(x, axis=None, dtype="int64"):
+    return _cum_extreme(x, axis, False)
+
+
+def logcumsumexp(x, axis=None):
+    def fn(a):
+        flat = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, flat, axis=ax)
+
+    return _u(fn, x)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+
+    def fn(seq, v):
+        if seq.ndim == 1:
+            return jnp.searchsorted(seq, v, side=side).astype(dt)
+        # batched over leading dims (reference supports ND sequences)
+        lead = seq.shape[:-1]
+        f = jnp.searchsorted
+        out = jax.vmap(lambda s, w: f(s, w, side=side))(
+            seq.reshape((-1,) + seq.shape[-1:]),
+            v.reshape((-1,) + v.shape[-1:]))
+        return out.reshape(lead + v.shape[-1:]).astype(dt)
+
+    return apply_op(fn, _t(sorted_sequence), _t(values))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return apply_op(
+        lambda a, s: jnp.searchsorted(s, a, side=side).astype(dt),
+        _t(x), _t(sorted_sequence))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    def vals(a):
+        v = jnp.sort(a, axis=axis)
+        out = jnp.take(v, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    def idxs(a):
+        i = jnp.argsort(a, axis=axis, stable=True)
+        out = jnp.take(i, k - 1, axis=axis)
+        return jnp.expand_dims(out, axis) if keepdim else out
+
+    return _u(vals, x), Tensor(idxs(_t(x)._data))
+
+
+def mode(x, axis=-1, keepdim=False):
+    """Most frequent value (smallest on ties) + its last index, reference
+    paddle.mode semantics."""
+
+    def fn(a):
+        counts = jnp.sum(a[..., :, None] == a[..., None, :], axis=-1)
+        # prefer higher count, then smaller value: argmax over (count, -val)
+        order = counts * a.shape[-1] * 2 - jnp.argsort(
+            jnp.argsort(a, axis=-1), axis=-1)
+        pick = jnp.argmax(jnp.moveaxis(order, axis, -1), axis=-1)
+        val = jnp.take_along_axis(jnp.moveaxis(a, axis, -1),
+                                  pick[..., None], -1)[..., 0]
+        return val, pick
+
+    v = _u(lambda a: fn(a)[0], x)
+    i = Tensor(fn(_t(x)._data)[1])
+    if keepdim:
+        v = _u(lambda a: jnp.expand_dims(a, axis), v)
+        i = Tensor(jnp.expand_dims(i._data, axis))
+    return v, i
+
+
+def median(x, axis=None, keepdim=False, mode="avg"):
+    return _u(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    return _u(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return _u(lambda a: jnp.quantile(a, jnp.asarray(q), axis=axis,
+                                     keepdims=keepdim,
+                                     method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear"):
+    return _u(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=axis,
+                                        keepdims=keepdim,
+                                        method=interpolation), x)
+
+
+# ----------------------------------------------------- reductions / numerics
+
+
+def amax(x, axis=None, keepdim=False):
+    return _u(lambda a: jnp.amax(a, axis=axis, keepdims=keepdim), x)
+
+
+def amin(x, axis=None, keepdim=False):
+    return _u(lambda a: jnp.amin(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return _u(lambda a: jnp.nanmean(a, axis=axis, keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return _u(lambda a: jnp.nansum(a, axis=axis, dtype=dtype,
+                                   keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return _u(lambda a: jnp.count_nonzero(a, axis=axis, keepdims=keepdim), x)
+
+
+def logaddexp(x, y):
+    return _b(jnp.logaddexp, x, y)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    xs = None if x is None else _t(x)._data
+    step = 1.0 if (dx is None and x is None) else dx
+    if xs is not None:
+        return _u(lambda a: jnp.trapezoid(a, x=xs, axis=axis), y)
+    return _u(lambda a: jnp.trapezoid(a, dx=step, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    def fn(a):
+        am = jnp.moveaxis(a, axis, -1)
+        if x is not None:
+            xv = jnp.moveaxis(jnp.broadcast_to(_t(x)._data, a.shape),
+                              axis, -1)
+            widths = xv[..., 1:] - xv[..., :-1]
+        else:
+            widths = dx if dx is not None else 1.0
+        areas = (am[..., 1:] + am[..., :-1]) / 2.0 * widths
+        return jnp.moveaxis(jnp.cumsum(areas, axis=-1), -1, axis)
+
+    return _u(fn, y)
+
+
+def renorm(x, p, axis, max_norm):
+    def fn(a):
+        am = jnp.moveaxis(a, axis, 0)
+        flat = am.reshape(am.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        return jnp.moveaxis(am * scale[(...,) + (None,) * (am.ndim - 1)],
+                            0, axis)
+
+    return _u(fn, x)
+
+
+# --------------------------------------------------------------- elementwise
+
+
+def trunc(x, name=None):
+    return _u(jnp.trunc, x)
+
+
+def frac(x, name=None):
+    return _u(lambda a: a - jnp.trunc(a), x)
+
+
+def frac_(x):
+    out = frac(x)
+    x.set_value(out)
+    return x
+
+
+def fmod(x, y):
+    return _b(jnp.fmod, x, y)
+
+
+def fmax(x, y):
+    return _b(jnp.fmax, x, y)
+
+
+def fmin(x, y):
+    return _b(jnp.fmin, x, y)
+
+
+def neg(x):
+    return _u(jnp.negative, x)
+
+
+def signbit(x):
+    return _u(jnp.signbit, x)
+
+
+def heaviside(x, y):
+    return _b(jnp.heaviside, x, y)
+
+
+def copysign(x, y):
+    return _b(jnp.copysign, x, y)
+
+
+def hypot(x, y):
+    return _b(jnp.hypot, x, y)
+
+
+def nextafter(x, y):
+    return _b(jnp.nextafter, x, y)
+
+
+def ldexp(x, y):
+    return _b(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), x, y)
+
+
+def frexp(x):
+    m = _u(lambda a: jnp.frexp(a)[0], x)
+    e = Tensor(jnp.frexp(_t(x)._data)[1].astype(jnp.int32))
+    return m, e
+
+
+def gcd(x, y):
+    return _b(jnp.gcd, x, y)
+
+
+def lcm(x, y):
+    return _b(jnp.lcm, x, y)
+
+
+def float_power(x, y):
+    return _b(lambda a, b: jnp.power(a.astype(jnp.float32),
+                                     jnp.asarray(b, jnp.float32)), x, y)
+
+
+def erfinv(x):
+    from jax.scipy.special import erfinv as _f
+
+    return _u(_f, x)
+
+
+def lgamma(x):
+    from jax.scipy.special import gammaln
+
+    return _u(gammaln, x)
+
+
+def digamma(x):
+    from jax.scipy.special import digamma as _f
+
+    return _u(_f, x)
+
+
+def polygamma(x, n):
+    from jax.scipy.special import polygamma as _f
+
+    return _u(lambda a: _f(n, a), x)
+
+
+def i0(x):
+    from jax.scipy.special import i0 as _f
+
+    return _u(_f, x)
+
+
+def i0e(x):
+    from jax.scipy.special import i0e as _f
+
+    return _u(_f, x)
+
+
+def i1(x):
+    from jax.scipy.special import i1 as _f
+
+    return _u(_f, x)
+
+
+def i1e(x):
+    from jax.scipy.special import i1e as _f
+
+    return _u(_f, x)
+
+
+def sinc(x):
+    return _u(jnp.sinc, x)
+
+
+def xlogy(x, y):
+    from jax.scipy.special import xlogy as _f
+
+    return _b(_f, x, y)
+
+
+# ------------------------------------------------------------------- complex
+
+
+def angle(x):
+    return _u(jnp.angle, x)
+
+
+def real(x):
+    return _u(jnp.real, x)
+
+
+def imag(x):
+    return _u(jnp.imag, x)
+
+
+def conj(x):
+    return _u(jnp.conj, x)
+
+
+def isreal(x):
+    return _u(jnp.isreal, x)
+
+
+def polar(abs, angle):  # noqa: A002 — reference signature
+    return apply_op(lambda r, t: (r * jnp.cos(t) + 1j * r * jnp.sin(t))
+                    .astype(jnp.complex64), _t(abs), _t(angle))
+
+
+def as_complex(x):
+    return _u(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x):
+    return _u(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), x)
+
+
+# ------------------------------------------------------------------- bitwise
+
+
+def bitwise_and(x, y):
+    return _b(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y):
+    return _b(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y):
+    return _b(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x):
+    return _u(jnp.bitwise_not, x)
+
+
+def bitwise_left_shift(x, y):
+    return _b(jnp.left_shift, x, y)
+
+
+def bitwise_right_shift(x, y):
+    return _b(jnp.right_shift, x, y)
+
+
+# ------------------------------------------------------------ layout / shape
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return _u(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def unfold(x, axis, size, step):
+    """Sliding windows along ``axis`` as a trailing dim (reference:
+    paddle.unfold / Tensor.unfold)."""
+
+    def fn(a):
+        am = jnp.moveaxis(a, axis, -1)
+        n = (am.shape[-1] - size) // step + 1
+        starts = jnp.arange(n) * step
+        win = starts[:, None] + jnp.arange(size)[None, :]
+        out = am[..., win]  # [..., n, size]
+        return jnp.moveaxis(out, -2, axis if axis >= 0 else a.ndim + axis)
+
+    return _u(fn, x)
+
+
+def vsplit(x, num_or_indices):
+    arrs = jnp.split(_t(x)._data, num_or_indices, axis=0)
+    return [Tensor(a) for a in arrs]
+
+
+def hsplit(x, num_or_indices):
+    arrs = jnp.split(_t(x)._data, num_or_indices, axis=1)
+    return [Tensor(a) for a in arrs]
+
+
+def dsplit(x, num_or_indices):
+    arrs = jnp.split(_t(x)._data, num_or_indices, axis=2)
+    return [Tensor(a) for a in arrs]
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    arrs = jnp.array_split(_t(x)._data, num_or_indices, axis=axis)
+    return [Tensor(a) for a in arrs]
+
+
+def diagflat(x, offset=0):
+    return _u(lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return _u(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), x)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        rows = jnp.arange(a.shape[-1]) + max(-offset, 0)
+        cols = jnp.arange(a.shape[-1]) + max(offset, 0)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        out = out.at[..., rows, cols].set(a)
+        # move the two new dims to dim1/dim2
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        if (d1, d2) != (nd - 2, nd - 1):
+            out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+        return out
+
+    return _u(fn, x)
+
+
+def tril_indices(row, col=None, offset=0):
+    r, c = np.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.asarray(np.stack([r, c])))
+
+
+def triu_indices(row, col=None, offset=0):
+    r, c = np.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.asarray(np.stack([r, c])))
+
+
+def vander(x, n=None, increasing=False):
+    return _u(lambda a: jnp.vander(a, N=n, increasing=increasing), x)
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    return Tensor(jnp.logspace(
+        float(start._data if isinstance(start, Tensor) else start),
+        float(stop._data if isinstance(stop, Tensor) else stop),
+        int(num), base=float(base), dtype=dtype or jnp.float32))
+
+
+# --------------------------------------------------- matrix-ish composites
+
+
+def addmv(input, x, y, beta=1.0, alpha=1.0):
+    return apply_op(
+        lambda i, a, v: beta * i + alpha * jnp.einsum("ij,j->i", a, v),
+        _t(input), _t(x), _t(y))
+
+
+def baddbmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply_op(
+        lambda i, a, b: beta * i + alpha * jnp.einsum("bij,bjk->bik", a, b),
+        _t(input), _t(x), _t(y))
+
+
+# -------------------------------------------------------------- logic/dedup
+
+
+def equal_all(x, y):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64"):
+    """Collapse consecutive duplicates (dynamic output shape → evaluated on
+    host, like the reference's dynamic-shape kernel)."""
+    a = np.asarray(jax.device_get(_t(x)._data))
+    if axis is None:
+        a = a.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    moved = np.moveaxis(a, ax, 0)
+    if moved.shape[0] == 0:
+        keep = np.zeros((0,), bool)
+    else:
+        flat = moved.reshape(moved.shape[0], -1)
+        keep = np.concatenate(
+            [[True], np.any(flat[1:] != flat[:-1], axis=1)])
+    out = np.moveaxis(moved[keep], 0, ax)
+    rets = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        rets.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, moved.shape[0]))
+        rets.append(Tensor(jnp.asarray(counts)))
+    return rets[0] if len(rets) == 1 else tuple(rets)

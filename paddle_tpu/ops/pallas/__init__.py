@@ -12,4 +12,10 @@ from .decode_attention import (
     decode_attention_pallas,
     decode_attention_ref,
 )
-from .flash_attention import flash_attention_fused
+from .flash_attention import flash_attention_fused, flash_attention_with_lse
+from .paged_attention import (
+    PagedKVCache,
+    paged_decode_attention,
+    paged_decode_attention_ref,
+    quantize_rows_int8,
+)

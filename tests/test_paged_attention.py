@@ -1,0 +1,176 @@
+"""Paged KV cache tests (VERDICT r1 #5; reference:
+fused_multi_transformer_op.cu contiguous cache + fused_multi_transformer_
+int8_op.cu): kernel-vs-reference numerics, block-table management, int8
+quantized pages, and equality against the contiguous-cache decode path."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.decode_attention import decode_attention_ref
+from paddle_tpu.ops.pallas.paged_attention import (
+    PagedKVCache,
+    paged_decode_attention,
+    paged_decode_attention_ref,
+    quantize_rows_int8,
+)
+
+B, H, HKV, D, PS = 3, 8, 4, 64, 16
+
+
+@pytest.fixture
+def filled(rng):
+    cache = PagedKVCache(num_pages=64, page_size=PS, batch_size=B,
+                         num_kv_heads=HKV, head_dim=D, max_pages_per_seq=8,
+                         dtype=jnp.float32)
+    s0 = 20  # crosses a page boundary, last page partial
+    k0 = jnp.asarray(rng.standard_normal((B, s0, HKV, D)), jnp.float32)
+    v0 = jnp.asarray(rng.standard_normal((B, s0, HKV, D)), jnp.float32)
+    cache.prefill(k0, v0)
+    ks, vs = [np.asarray(k0)], [np.asarray(v0)]
+    for _ in range(5):
+        ka = jnp.asarray(rng.standard_normal((B, HKV, D)), jnp.float32)
+        va = jnp.asarray(rng.standard_normal((B, HKV, D)), jnp.float32)
+        cache.append(ka, va)
+        ks.append(np.asarray(ka)[:, None])
+        vs.append(np.asarray(va)[:, None])
+    kc = jnp.asarray(np.swapaxes(np.concatenate(ks, 1), 1, 2))  # [B,HKV,S,D]
+    vc = jnp.asarray(np.swapaxes(np.concatenate(vs, 1), 1, 2))
+    return cache, kc, vc, s0 + 5
+
+
+class TestPagedDecode:
+    def test_matches_contiguous_reference(self, filled, rng):
+        cache, kc, vc, s = filled
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        out = cache.attend(q)
+        ref = decode_attention_ref(q, kc, vc, jnp.full((B,), s))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_kernel_matches_ref_twin(self, filled, rng):
+        cache, _, _, _ = filled
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        out_k = paged_decode_attention(q, cache.k_pages, cache.v_pages,
+                                       cache.block_tables, cache.lengths)
+        out_r = paged_decode_attention_ref(q, cache.k_pages, cache.v_pages,
+                                           cache.block_tables, cache.lengths)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                                   atol=2e-5)
+
+    def test_ragged_lengths(self, rng):
+        """Slots with different lengths mask independently."""
+        cache = PagedKVCache(num_pages=32, page_size=PS, batch_size=2,
+                             num_kv_heads=HKV, head_dim=D,
+                             max_pages_per_seq=4, dtype=jnp.float32)
+        k0 = jnp.asarray(rng.standard_normal((2, 10, HKV, D)), jnp.float32)
+        v0 = jnp.asarray(rng.standard_normal((2, 10, HKV, D)), jnp.float32)
+        cache.prefill(k0, v0)
+        # advance only slot 0 by hand-editing lengths via append on a
+        # 1-batch view is not supported; instead compare against a
+        # contiguous ref at the recorded ragged lengths
+        cache.lengths = np.array([10, 7], np.int32)  # slot 1 shorter
+        q = jnp.asarray(rng.standard_normal((2, H, D)), jnp.float32)
+        out = paged_decode_attention_ref(
+            q, cache.k_pages, cache.v_pages, cache.block_tables,
+            cache.lengths)
+        ref = decode_attention_ref(q, jnp.swapaxes(k0, 1, 2),
+                                   jnp.swapaxes(v0, 1, 2),
+                                   jnp.asarray([10, 7]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_page_recycling(self, filled):
+        cache, _, _, _ = filled
+        free_before = len(cache._free)
+        used = (int(cache.lengths[1]) + PS - 1) // PS
+        cache.free(1)
+        assert len(cache._free) == free_before + used
+        assert cache.lengths[1] == 0
+
+    def test_pool_exhaustion(self, rng):
+        cache = PagedKVCache(num_pages=2, page_size=4, batch_size=1,
+                             num_kv_heads=1, head_dim=D, max_pages_per_seq=8,
+                             dtype=jnp.float32)
+        k = jnp.zeros((1, 8, 1, D)); v = jnp.zeros((1, 8, 1, D))
+        cache.prefill(k, v)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            cache.append(jnp.zeros((1, 1, D)), jnp.zeros((1, 1, D)))
+
+
+class TestInt8Cache:
+    def test_quantize_roundtrip(self, rng):
+        x = jnp.asarray(rng.standard_normal((4, 7, D)), jnp.float32)
+        vals, scales = quantize_rows_int8(x)
+        assert vals.dtype == jnp.int8
+        back = np.asarray(vals, np.float32) * np.asarray(scales)[..., None]
+        assert np.abs(back - np.asarray(x)).max() < np.abs(
+            np.asarray(x)).max() / 100
+
+    def test_int8_close_to_fp(self, rng):
+        cache = PagedKVCache(num_pages=64, page_size=PS, batch_size=B,
+                             num_kv_heads=HKV, head_dim=D,
+                             max_pages_per_seq=8, quantized=True)
+        s0 = 20
+        k0 = jnp.asarray(rng.standard_normal((B, s0, HKV, D)), jnp.float32)
+        v0 = jnp.asarray(rng.standard_normal((B, s0, HKV, D)), jnp.float32)
+        cache.prefill(k0, v0)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        out = cache.attend(q)
+        ref = decode_attention_ref(q, jnp.swapaxes(k0, 1, 2),
+                                   jnp.swapaxes(v0, 1, 2), jnp.full((B,), s0))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=5e-2)
+
+    def test_int8_kernel_matches_ref_twin(self, rng):
+        cache = PagedKVCache(num_pages=64, page_size=PS, batch_size=B,
+                             num_kv_heads=HKV, head_dim=D,
+                             max_pages_per_seq=8, quantized=True)
+        k0 = jnp.asarray(rng.standard_normal((B, 20, HKV, D)), jnp.float32)
+        v0 = jnp.asarray(rng.standard_normal((B, 20, HKV, D)), jnp.float32)
+        cache.prefill(k0, v0)
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.float32)
+        out_k = paged_decode_attention(
+            q, cache.k_pages, cache.v_pages, cache.block_tables,
+            cache.lengths, k_scales=cache.k_scales, v_scales=cache.v_scales)
+        out_r = paged_decode_attention_ref(
+            q, cache.k_pages, cache.v_pages, cache.block_tables,
+            cache.lengths, k_scales=cache.k_scales, v_scales=cache.v_scales)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_r),
+                                   atol=2e-5)
+
+
+class TestFusedTransformerPaged:
+    def test_generation_matches_contiguous_cache(self, rng):
+        """FusedMultiTransformer with paged caches must produce the same
+        tokens as with the reference's contiguous [2,b,nh,S,hd] caches."""
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        from paddle_tpu.framework.tensor import Tensor
+
+        emb, nh, ff, L = 32, 4, 64, 2
+        m = FusedMultiTransformer(emb, nh, ff, num_layers=L)
+        m.eval()
+        b, s0, smax = 2, 6, 16
+        hd = emb // nh
+        x = jnp.asarray(rng.standard_normal((b, s0, emb)), jnp.float32)
+
+        cont = [jnp.zeros((2, b, nh, smax, hd), jnp.float32)
+                for _ in range(L)]
+        paged = [PagedKVCache(num_pages=16, page_size=8, batch_size=b,
+                              num_kv_heads=nh, head_dim=hd,
+                              max_pages_per_seq=2, dtype=jnp.float32)
+                 for _ in range(L)]
+
+        y1, cont = m(Tensor._wrap(x), caches=cont)
+        y2, paged = m(Tensor._wrap(x), caches=paged)
+        np.testing.assert_allclose(np.asarray(y1._data),
+                                   np.asarray(y2._data), atol=1e-5)
+
+        tok = jnp.asarray(rng.standard_normal((b, 1, emb)), jnp.float32)
+        for step in range(s0, s0 + 3):
+            d1, cont = m(Tensor._wrap(tok), caches=cont, time_step=step)
+            d2, paged = m(Tensor._wrap(tok), caches=paged, time_step=step)
+            np.testing.assert_allclose(np.asarray(d1._data),
+                                       np.asarray(d2._data), atol=1e-4,
+                                       err_msg=f"step {step}")
+            tok = d1

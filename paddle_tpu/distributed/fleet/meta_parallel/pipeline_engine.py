@@ -112,28 +112,35 @@ class PipelineParallel(MetaParallelBase):
             )
         self._mesh = None
         self._state: Optional[Dict[str, jax.Array]] = None
+        self._frozen_buffer_keys: set = set()
         self._opt_state = None
         self._decay_mask = None
         self._step_cache: Dict[Any, Any] = {}
         self._fwd_cache: Dict[Any, Any] = {}
         self._step_count = 0
         self._template = (layers.body_layers[0] if layers.body_layers else None)
+        freeze = getattr(layers, "_freeze_buffers", False)
         if self._template is not None and any(
             b is not None for _, b in self._template.named_buffers()
-        ):
+        ) and not freeze:
             raise NotImplementedError(
                 "pipeline body layers with buffers (BatchNorm-style running "
-                "stats) are not supported in the compiled schedule"
+                "stats) are not supported in the compiled schedule; pass "
+                "PipelineLayer(freeze_buffers=True) to capture them as "
+                "trace-time constants (eval/frozen-stat semantics)"
             )
         a, b = layers._body_range
         for i, layer in enumerate(layers.run_function):
             if a <= i < b:
                 continue
-            if any(buf is not None for _, buf in layer.named_buffers()):
+            if any(buf is not None for _, buf in layer.named_buffers()) \
+                    and not freeze:
                 raise NotImplementedError(
                     f"pre/post pipeline layer {i} ({type(layer).__name__}) "
                     "has buffers; buffer state is not threaded through the "
-                    "compiled schedule yet and would freeze at first trace"
+                    "compiled schedule and would freeze at first trace — "
+                    "pass PipelineLayer(freeze_buffers=True) to accept "
+                    "frozen (eval-mode) buffer semantics"
                 )
         for l in layers.body_layers:
             if isinstance(l, _SharedLayerProxy) or any(
@@ -219,6 +226,42 @@ class PipelineParallel(MetaParallelBase):
                     stacked, NamedSharding(mesh, full_spec)
                 )
                 decay[key] = self._decay_applies_param(tmpl_p)
+            # frozen buffers (PipelineLayer(freeze_buffers=True)): stacked
+            # per-layer like params so every stage/chunk reads ITS layer's
+            # values (the template alone would alias layer 0's buffers onto
+            # all stages), carried through the same b:: plumbing but pinned:
+            # zero grads + no decay at the update (see train_batch)
+            self._frozen_buffer_keys = set()
+            if getattr(model, "_freeze_buffers", False):
+                buf_leaves = [n for n, b in self._template.named_buffers()
+                              if b is not None]
+                for n_, b_ in self._template.named_buffers():
+                    if b_ is not None and not jnp.issubdtype(
+                            b_._data.dtype, jnp.floating):
+                        raise NotImplementedError(
+                            f"freeze_buffers: body buffer {n_!r} has "
+                            f"non-float dtype {b_._data.dtype} — it would "
+                            "enter the differentiated state tree; only "
+                            "float buffers (e.g. BatchNorm running stats) "
+                            "are supported in pipeline bodies")
+                per_layer_b = [dict(l.named_buffers())
+                               for l in model.body_layers]
+                for leaf in buf_leaves:
+                    arrs = [pl[leaf]._data for pl in per_layer_b]
+                    if v > 1:
+                        stacked = jnp.stack(arrs).reshape(
+                            (v, self._pp, Kc) + tuple(arrs[0].shape)
+                        ).swapaxes(0, 1)
+                        full_spec = P("pp", *([None] * (stacked.ndim - 1)))
+                    else:
+                        stacked = jnp.stack(arrs).reshape(
+                            (self._pp, K) + tuple(arrs[0].shape))
+                        full_spec = P("pp", *([None] * (stacked.ndim - 1)))
+                    key = f"b::{leaf}"
+                    state[key] = jax.device_put(
+                        stacked, NamedSharding(mesh, full_spec))
+                    decay[key] = False
+                    self._frozen_buffer_keys.add(key)
         self._state = state
         self._decay_mask = decay
 
@@ -258,18 +301,32 @@ class PipelineParallel(MetaParallelBase):
     def _swapped(self, state):
         """Swap traced arrays into pre/post param Tensors for the duration of
         a trace (the whole-model analogue of jit.functional_call; tied params
-        see one shared leaf through the alias map)."""
+        see one shared leaf through the alias map). Pre/post BUFFER storage
+        is saved/restored too: a buffer-mutating forward (train-mode
+        BatchNorm under freeze_buffers=True) must not leak tracers into the
+        live Tensors — mutations are discarded, frozen semantics."""
         named = self._prepost_named()
         saved = {}
+        buf_saved = []
+        model = self._layers
+        a, b = model._body_range
         try:
             for name, p in named.items():
                 canon = self._alias.get(name, name)
                 saved[name] = p._data
                 p._data = state[f"p::{canon}"]
+            for i, layer in enumerate(model.run_function):
+                if a <= i < b or not hasattr(layer, "named_buffers"):
+                    continue
+                for _, buf in layer.named_buffers():
+                    if buf is not None:
+                        buf_saved.append((buf, buf._data))
             yield
         finally:
             for name, arr in saved.items():
                 named[name]._data = arr
+            for buf, arr in buf_saved:
+                buf._data = arr
 
     def _pipeline_fwd(self, state, x_arr, micro: int, training: bool):
         """Pure forward: pre → shard_map GPipe over 'pp' → post. Returns the
@@ -861,6 +918,35 @@ class PipelineParallel(MetaParallelBase):
             return arr
         return jax.device_put(arr, NamedSharding(mesh, P(dp_axes)))
 
+    def invalidate_compiled(self):
+        """Drop compiled step/forward executables and re-capture frozen
+        buffer values. Needed after externally mutating buffers under
+        PipelineLayer(freeze_buffers=True): pre/post buffers are trace-time
+        constants (re-traced fresh), body buffers live in the stacked
+        runtime state and are restacked here from the layers."""
+        self._step_cache.clear()
+        self._fwd_cache.clear()
+        if self._state is None or not self._frozen_buffer_keys:
+            return
+        model = self._layers
+        mesh = self._get_mesh()
+        K = model.layers_per_stage
+        v = self._vpp
+        Kc = model.layers_per_chunk
+        per_layer_b = [dict(l.named_buffers()) for l in model.body_layers]
+        for key in self._frozen_buffer_keys:
+            leaf = key[len("b::"):]
+            arrs = [pl[leaf]._data for pl in per_layer_b]
+            if v > 1:
+                stacked = jnp.stack(arrs).reshape(
+                    (v, self._pp, Kc) + tuple(arrs[0].shape)).swapaxes(0, 1)
+            else:
+                stacked = jnp.stack(arrs).reshape(
+                    (self._pp, K) + tuple(arrs[0].shape))
+            full_spec = P("pp", *([None] * (stacked.ndim - 1)))
+            self._state[key] = jax.device_put(
+                stacked, NamedSharding(mesh, full_spec))
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """One pipelined global-batch step (reference:
         PipelineParallel.train_batch). ``data`` is ``[inputs, labels]`` of the
@@ -946,9 +1032,16 @@ class PipelineParallel(MetaParallelBase):
                 )(state, x_in, y_in, scale, step_i)
                 return loss, grads
 
+            frozen = getattr(self, "_frozen_buffer_keys", set())
+
             @jax.jit
             def step(state, opt_state, x_in, y_in, lr, step_i, scale):
                 loss, grads = loss_and_grads(state, x_in, y_in, scale, step_i)
+                # frozen buffers ride the state tree but never update: zero
+                # their grads (decay is already masked off), so any update
+                # rule is the identity for them
+                grads = {k: (jnp.zeros_like(g) if k in frozen else g)
+                         for k, g in grads.items()}
                 grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
                 flat = jax.tree_util.tree_leaves(grads)
                 finite = jnp.all(

@@ -111,7 +111,8 @@ class PipelineLayer(nn.Layer):
     def __init__(self, layers: Sequence, num_stages: Optional[int] = None,
                  topology=None, loss_fn: Optional[Callable] = None,
                  seg_method: str = "uniform", recompute_interval: int = 0,
-                 num_virtual_pipeline_stages: Optional[int] = None):
+                 num_virtual_pipeline_stages: Optional[int] = None,
+                 freeze_buffers: bool = False):
         super().__init__()
         if num_stages is None and topology is not None:
             num_stages = topology.get_dim("pp")
@@ -122,6 +123,12 @@ class PipelineLayer(nn.Layer):
         self._seg_method = seg_method
         self._recompute_interval = int(recompute_interval)
         self._topology = topology
+        # opt-in: carry layer buffers as FROZEN state through the compiled
+        # schedule — right for eval/frozen-stat models (float buffers only
+        # in the body, e.g. BatchNorm running stats; forward-pass buffer
+        # mutation is discarded). After externally changing buffer values,
+        # call engine.invalidate_compiled() to re-capture them.
+        self._freeze_buffers = bool(freeze_buffers)
         self._num_virtual_stages = int(num_virtual_pipeline_stages or 1)
         if self._num_virtual_stages < 1:
             raise ValueError("num_virtual_pipeline_stages must be >= 1")

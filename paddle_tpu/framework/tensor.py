@@ -120,6 +120,17 @@ def _is_float(dt) -> bool:
     )
 
 
+# Static-graph capture (paddle.static): set by paddle_tpu.static when
+# enable_static() is active so the hot path pays only a bool check.
+_STATIC_CAPTURE = False
+
+
+def _static_record(fn, inputs, kwargs, outputs):
+    from .. import static as _static
+
+    _static._maybe_record(fn, inputs, kwargs, outputs)
+
+
 def apply_op(fn: Callable, *inputs, **kwargs):
     """Run ``fn`` (a pure jax function of raw arrays) on mixed Tensor/array
     inputs, recording a VJP node on the tape when gradients are required.
@@ -140,6 +151,8 @@ def apply_op(fn: Callable, *inputs, **kwargs):
         outs = fn(*arrays, **kwargs)
         multi = isinstance(outs, (tuple, list))
         outs_t = tuple(Tensor._wrap(o, stop_gradient=True) for o in (outs if multi else (outs,)))
+        if _STATIC_CAPTURE:
+            _static_record(fn, inputs, kwargs, outs_t)
         return outs_t if multi else outs_t[0]
 
     def pure(*primals):
@@ -165,6 +178,8 @@ def apply_op(fn: Callable, *inputs, **kwargs):
             t._out_index = k
             node.out_tensors[k] = t
         wrapped.append(t)
+    if _STATIC_CAPTURE:
+        _static_record(fn, inputs, kwargs, tuple(wrapped))
     return tuple(wrapped) if multi else wrapped[0]
 
 

@@ -10,3 +10,10 @@ from .bert import (  # noqa: F401
     BertModel,
     BertPretrainingCriterion,
 )
+from .llama import (  # noqa: F401
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaModel,
+    llama2_7b,
+    tiny_llama_config,
+)

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from .. import nn
 from ..nn import functional as F
 from ..framework.tensor import Tensor, apply_op
+from .generation import GenerationMixin
 
 
 @dataclass
@@ -183,8 +184,9 @@ class GPTModel(nn.Layer):
         return [Tensor._wrap(jnp.zeros(shape, dtype)) for _ in range(cfg.num_layers)]
 
 
-class GPTForCausalLM(nn.Layer):
-    """LM head tied to wte — logits = trunk(x) @ wte.weight^T."""
+class GPTForCausalLM(GenerationMixin, nn.Layer):
+    """LM head tied to wte — logits = trunk(x) @ wte.weight^T. Generation
+    (compiled prefill + scan decode) comes from GenerationMixin."""
 
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -202,159 +204,8 @@ class GPTForCausalLM(nn.Layer):
         w = self.gpt.wte.weight
         return apply_op(lambda a, we: jnp.einsum("bsh,vh->bsv", a, we.astype(a.dtype)), x, w)
 
-    def generate(self, input_ids, max_new_tokens=32, temperature=1.0, top_k=0,
-                 seed=0, max_seq=None):
-        """Autoregressive generation over the KV cache (reference capability:
-        FusedMultiTransformer decode path, fused_multi_transformer_op.cu —
-        prefill once, then one decode-kernel step per token).
-
-        Greedy when temperature==0 (or top_k==1); otherwise samples from the
-        (optionally top-k-truncated) softmax. Returns [B, prompt+new] ids.
-        """
-        from ..framework.tensor import no_grad
-
-        was_training = self.training
-        self.eval()
-        try:
-            with no_grad():
-                return self._generate(input_ids, max_new_tokens, temperature,
-                                      top_k, seed, max_seq)
-        finally:
-            if was_training:
-                self.train()
-
-    def _pick_fn(self, temperature, top_k, dtype):
-        def pick(logits_last, key):
-            if temperature == 0.0 or top_k == 1:
-                return jnp.argmax(logits_last, axis=-1).astype(dtype)
-            lg = logits_last / max(temperature, 1e-6)
-            if top_k > 1:
-                kth = jnp.sort(lg, axis=-1)[:, -top_k][:, None]
-                lg = jnp.where(lg < kth, -jnp.inf, lg)
-            return jax.random.categorical(key, lg, axis=-1).astype(dtype)
-
-        return pick
-
-    def _swapped_params(self):
-        """(current param arrays, swap-context) — the whole-trace analogue of
-        jit.functional_call's per-call swap, shared via jit.swapped_params."""
-        from ..jit import swapped_params
-
-        named = list(self.named_parameters())
-        return [p._data for _, p in named], (
-            lambda arrs: swapped_params(self, arrs)
-        )
-
-    def _decode_jitted(self, T, temperature, top_k):
-        """ONE compiled program for the whole decode: lax.scan over T steps
-        (prefill excluded). The reference decodes with one CUDA-kernel pass
-        per token (fused_multi_transformer_op.cu); the eager per-token loop
-        here would pay per-dispatch latency × ops × layers, so the scan is
-        the TPU-idiomatic equivalent. Cache key: (T, sampling config,
-        shapes via jit)."""
-        from collections import OrderedDict
-
-        cache = self.__dict__.setdefault("_decode_cache", OrderedDict())
-        key = (T, float(temperature), int(top_k))
-        if key in cache:
-            cache.move_to_end(key)
-            return cache[key]
-        while len(cache) >= 8:  # bound compiled-executable retention
-            cache.popitem(last=False)
-        from ..framework.tensor import pause_tape
-
-        import functools
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def run(params, caches, first_tok, rkey, start_t):
-            _, ctx = self._swapped_params()
-            pick = self._pick_fn(temperature, top_k, first_tok.dtype)
-
-            with ctx(params), pause_tape():
-                def body(carry, i):
-                    caches, last, rkey = carry
-                    logits, new_caches = self.forward(
-                        Tensor._wrap(last[:, None]),
-                        caches=[Tensor._wrap(c) for c in caches],
-                        time_step=start_t + i,
-                    )
-                    lg = logits._data if isinstance(logits, Tensor) else logits
-                    rkey, sub = jax.random.split(rkey)
-                    nxt = pick(lg[:, -1], sub)
-                    new_caches = [c._data if isinstance(c, Tensor) else c
-                                  for c in new_caches]
-                    return (new_caches, nxt, rkey), nxt
-
-                (caches, _, _), toks = jax.lax.scan(
-                    body, (caches, first_tok, rkey), jnp.arange(T)
-                )
-            return jnp.swapaxes(toks, 0, 1), caches  # [b, T]
-
-        cache[key] = run
-        return run
-
-    def _prefill_jitted(self):
-        """Compiled prompt pass (shape-cached by jit): eager per-op dispatch
-        here would cost hundreds of device round-trips."""
-        cache = self.__dict__.setdefault("_prefill_cache", {})
-        if "fn" in cache:
-            return cache["fn"]
-        from ..framework.tensor import pause_tape
-
-        @jax.jit
-        def run(params, caches, ids):
-            _, ctx = self._swapped_params()
-            with ctx(params), pause_tape():
-                logits, new_caches = self.forward(
-                    Tensor._wrap(ids),
-                    caches=[Tensor._wrap(c) for c in caches],
-                )
-                lg = logits._data if isinstance(logits, Tensor) else logits
-                return lg[:, -1], [
-                    c._data if isinstance(c, Tensor) else c
-                    for c in new_caches
-                ]
-
-        cache["fn"] = run
-        return run
-
-    def _generate(self, input_ids, max_new_tokens, temperature, top_k, seed,
-                  max_seq):
-        ids = input_ids._data if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
-        b, prompt = ids.shape
-        if max_new_tokens <= 0:
-            return Tensor._wrap(ids)
-        total = max_seq or min(self.config.max_position, prompt + max_new_tokens)
-        caches = [c._data for c in self.gpt.init_caches(b, total)]
-
-        # prefill: one compiled pass over the prompt
-        params, _ = self._swapped_params()
-        last_logits, caches = self._prefill_jitted()(params, caches, ids)
-        key = jax.random.key(seed)
-        key, sub = jax.random.split(key)
-        pick = self._pick_fn(temperature, top_k, ids.dtype)
-        nxt = pick(last_logits, sub)
-        out = jnp.concatenate([ids, nxt[:, None]], axis=1)
-
-        # decode: token emitted after prefill sits at position `prompt`;
-        # step t writes its kv at cache slot t and predicts token t+1.
-        # T is bucketed to the next power of two (capped by cache capacity)
-        # so a serving loop with varying max_new_tokens reuses a handful of
-        # compiled scans instead of recompiling per length; surplus tokens
-        # are computed and sliced off.
-        T = min(max_new_tokens - 1, total - 1 - prompt)
-        if T > 0:
-            T_run = 1
-            while T_run < T:
-                T_run *= 2
-            T_run = min(T_run, total - 1 - prompt)
-            run = self._decode_jitted(T_run, temperature, top_k)
-            toks, _ = run(params,
-                          [c._data if isinstance(c, Tensor) else c
-                           for c in caches],
-                          nxt, key, jnp.int32(prompt))
-            out = jnp.concatenate([out, toks[:, :T]], axis=1)
-        return Tensor._wrap(out)
+    def init_caches(self, batch_size, max_seq, dtype=jnp.float32):
+        return self.gpt.init_caches(batch_size, max_seq, dtype)
 
     def loss(self, input_ids, labels):
         logits = self.forward(input_ids)

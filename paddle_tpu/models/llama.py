@@ -1,0 +1,236 @@
+"""LLaMA-family decoder-only transformer (RMSNorm + rotary embeddings +
+SwiGLU + grouped-query attention).
+
+Reference capability: the PaddleNLP llama model family served through the
+same fused stack the survey maps (fused_multi_transformer_op.cu with GQA
+decode, paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu,
+rms_norm_kernel.cu — SURVEY.md A3.x). TPU-native design mirrors models/gpt:
+
+* pre-RMSNorm blocks; rotary q/k via the shared fused_rotary helper
+  (position_ids-aware, so decode steps rotate at their true positions);
+* training/prefill attention through the Pallas flash kernel — GQA expands
+  k/v head groups before the kernel (compute-equivalent, standard TPU
+  practice); decode uses the Pallas decode kernel's NATIVE GQA path
+  (q head h reads kv head h // group) over the reference cache layout
+  [2, b, n_kv_heads, max_seq, head_dim];
+* SwiGLU MLP (gate ⊙ silu(up) — llama convention: down(silu(gate) * up));
+* untied LM head (llama convention), generation via GenerationMixin.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..framework.tensor import Tensor, apply_op
+from .generation import GenerationMixin
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "llama2_7b",
+           "tiny_llama_config"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32  # < num_heads → grouped-query attention
+    intermediate_size: int = 11008
+    max_position: int = 4096
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-6
+    initializer_range: float = 0.02
+    use_flash: bool = True
+
+    def __post_init__(self):
+        assert self.num_heads % self.num_kv_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    def num_params(self, include_embeddings=True):
+        h, l = self.hidden_size, self.num_layers
+        kvh = self.num_kv_heads * self.head_dim
+        n = l * (h * h + 2 * h * kvh + h * h          # q, k, v, o
+                 + 3 * h * self.intermediate_size)     # gate, up, down
+        if include_embeddings:
+            n += 2 * self.vocab_size * h  # embed + untied head
+        return n
+
+
+def llama2_7b():
+    return LlamaConfig()
+
+
+def tiny_llama_config(**kw):
+    base = dict(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                num_kv_heads=2, intermediate_size=128, max_position=128)
+    base.update(kw)
+    return LlamaConfig(**base)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, hd = config.hidden_size, config.head_dim
+        self.num_heads = config.num_heads
+        self.num_kv_heads = config.num_kv_heads
+        self.head_dim = hd
+        self.rope_theta = config.rope_theta
+        self.q_proj = nn.Linear(h, config.num_heads * hd, bias_attr=False)
+        self.k_proj = nn.Linear(h, config.num_kv_heads * hd, bias_attr=False)
+        self.v_proj = nn.Linear(h, config.num_kv_heads * hd, bias_attr=False)
+        self.o_proj = nn.Linear(config.num_heads * hd, h, bias_attr=False)
+
+    def _rope(self, q, k, time_step):
+        from ..incubate.nn.functional import fused_rotary_position_embedding
+
+        if time_step is None:
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, rotary_emb_base=self.rope_theta)
+        else:
+            b, s = (q._data if isinstance(q, Tensor) else q).shape[:2]
+            pos = apply_op(
+                lambda: jnp.broadcast_to(
+                    jnp.arange(s, dtype=jnp.int32)[None] + time_step, (b, s)))
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, position_ids=pos, rotary_emb_base=self.rope_theta)
+        return q, k
+
+    def forward(self, x, cache=None, time_step=None):
+        b, s, h = x.shape
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        q = self.q_proj(x).reshape([b, s, nh, hd])
+        k = self.k_proj(x).reshape([b, s, nkv, hd])
+        v = self.v_proj(x).reshape([b, s, nkv, hd])
+        q, k = self._rope(q, k, time_step)
+        new_cache = None
+        group = nh // nkv
+
+        def expand_kv(t):
+            if group == 1:
+                return t
+            return apply_op(lambda a: jnp.repeat(a, group, axis=2), t)
+
+        if cache is None:
+            out, _ = F.flash_attention(q, expand_kv(k), expand_kv(v),
+                                       causal=True, training=self.training)
+        elif time_step is None:
+            from ..ops.pallas.decode_attention import cache_prefill_write
+
+            new_cache = apply_op(cache_prefill_write, cache, k, v)
+            out, _ = F.flash_attention(q, expand_kv(k), expand_kv(v),
+                                       causal=True, training=False)
+        else:
+            # decode: the Pallas kernel reads kv head h // group natively
+            from ..ops.pallas.decode_attention import cache_decode_step
+
+            out, new_cache = apply_op(
+                lambda c, qa, ka, va: cache_decode_step(
+                    c, qa, ka, va, time_step),
+                cache, q, k, v)
+        out = self.o_proj(out.reshape([b, s, nh * hd]))
+        if cache is not None:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    """SwiGLU: down(silu(gate(x)) * up(x))."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, m = config.hidden_size, config.intermediate_size
+        self.gate_proj = nn.Linear(h, m, bias_attr=False)
+        self.up_proj = nn.Linear(h, m, bias_attr=False)
+        self.down_proj = nn.Linear(m, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaBlock(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(config.hidden_size,
+                                          epsilon=config.rms_eps)
+        self.self_attn = LlamaAttention(config)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size,
+                                                   epsilon=config.rms_eps)
+        self.mlp = LlamaMLP(config)
+
+    def forward(self, x, cache=None, time_step=None):
+        if cache is None:
+            x = x + self.self_attn(self.input_layernorm(x))
+            return x + self.mlp(self.post_attention_layernorm(x))
+        attn, new_cache = self.self_attn(self.input_layernorm(x),
+                                         cache=cache, time_step=time_step)
+        x = x + attn
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x, new_cache
+
+
+class LlamaModel(nn.Layer):
+    """Trunk: embedding + decoder stack + final RMSNorm."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(std=config.initializer_range)
+        self.embed_tokens = nn.Embedding(config.vocab_size,
+                                         config.hidden_size, weight_attr=init)
+        self.layers = nn.LayerList(
+            [LlamaBlock(config) for _ in range(config.num_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, epsilon=config.rms_eps)
+
+    def forward(self, input_ids, caches=None, time_step=None):
+        x = self.embed_tokens(input_ids)
+        if caches is None:
+            for block in self.layers:
+                x = block(x)
+            return self.norm(x)
+        new_caches = []
+        for block, cache in zip(self.layers, caches):
+            x, nc = block(x, cache=cache, time_step=time_step)
+            new_caches.append(nc)
+        return self.norm(x), new_caches
+
+    def init_caches(self, batch_size, max_seq, dtype=jnp.float32):
+        """Reference cache layout [2, b, n_kv_heads, max_seq, head_dim]
+        (fused_multi_transformer_op.cu convention, GQA-narrow)."""
+        cfg = self.config
+        shape = (2, batch_size, cfg.num_kv_heads, max_seq, cfg.head_dim)
+        return [Tensor._wrap(jnp.zeros(shape, dtype))
+                for _ in range(cfg.num_layers)]
+
+
+class LlamaForCausalLM(GenerationMixin, nn.Layer):
+    """Untied LM head (llama convention)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.model = LlamaModel(config)
+        self.lm_head = nn.Linear(config.hidden_size, config.vocab_size,
+                                 bias_attr=False)
+
+    def forward(self, input_ids, caches=None, time_step=None):
+        if caches is None:
+            return self.lm_head(self.model(input_ids))
+        x, new_caches = self.model(input_ids, caches=caches,
+                                   time_step=time_step)
+        return self.lm_head(x), new_caches
+
+    def init_caches(self, batch_size, max_seq, dtype=jnp.float32):
+        return self.model.init_caches(batch_size, max_seq, dtype)
+
+    def loss(self, input_ids, labels):
+        logits = self.forward(input_ids)
+        v = logits.shape[-1]
+        return F.cross_entropy(
+            logits.reshape([-1, v]), labels.reshape([-1]))

@@ -1,15 +1,36 @@
 """paddle.static surface (reference: python/paddle/static/).
 
-TPU-native stance (SURVEY.md §3.4): "static mode" is explicit jit capture —
-there is no global Program being mutated under the user. ``enable_static()``
-flips a flag consumed by dual-mode call sites; the real compiled path is
-``paddle_tpu.jit.to_static`` / ``jax.jit``. The Executor here runs captured
-programs (callables) rather than interpreting an op list — InterpreterCore's
-job (paddle/fluid/framework/new_executor/interpretercore.cc) belongs to XLA.
+TPU-native stance (SURVEY.md §3.4): "static mode" is explicit capture —
+but a REAL capture, not a placeholder. With static mode enabled, every
+``apply_op`` call records ``(fn, inputs, outputs)`` into the current
+``Program`` (the ProgramDesc analogue: an op list over named variables).
+``Executor.run`` replays the recorded op list as ONE jitted function of the
+feeds — XLA is the InterpreterCore
+(paddle/fluid/framework/new_executor/interpretercore.cc): dependency
+ordering, stream assignment and buffer liveness all come from the compiler,
+not a hand-written scheduler.
+
+Classic reference UX works end-to-end:
+
+    paddle.enable_static()
+    x = paddle.static.data("x", [None, 8])
+    y = my_net(x)                       # ops recorded into main_program
+    exe = paddle.static.Executor()
+    out, = exe.run(feed={"x": arr}, fetch_list=[y])
+
+Divergence from the reference, by design: parameter initialization is EAGER
+(it happens when the Layer is constructed), so startup programs are
+accepted for API compatibility but always empty — there are no init ops to
+collect, and ``exe.run(startup)`` is a documented no-op.
 """
 from __future__ import annotations
 
+from typing import Dict, List, Optional
+
 from ..jit import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec", "Program", "Executor", "data", "program_guard",
+           "default_main_program", "default_startup_program"]
 
 _static_mode = False
 
@@ -17,11 +38,17 @@ _static_mode = False
 def _enable():
     global _static_mode
     _static_mode = True
+    from ..framework import tensor as _tensor
+
+    _tensor._STATIC_CAPTURE = True
 
 
 def _disable():
     global _static_mode
     _static_mode = False
+    from ..framework import tensor as _tensor
+
+    _tensor._STATIC_CAPTURE = False
 
 
 def _enabled():
@@ -29,39 +56,169 @@ def _enabled():
 
 
 class Program:
-    """Placeholder program object for API parity; holds a captured callable."""
+    """Recorded op list over variables (the ProgramDesc analogue).
+
+    ``ops``: list of (fn, input_tensors, kwargs, output_tensors); variables
+    are identified by Tensor object identity, feeds by ``data()`` name."""
 
     def __init__(self, fn=None):
-        self._fn = fn
+        self._fn = fn  # legacy captured-callable mode (jit.to_static path)
+        self.ops: List[tuple] = []
+        self.feeds: Dict[str, object] = {}  # name -> placeholder Tensor
+
+    def _record(self, fn, inputs, kwargs, outputs):
+        self.ops.append((fn, tuple(inputs), dict(kwargs), tuple(outputs)))
 
     def clone(self, for_test=False):
-        return Program(self._fn)
+        p = Program(self._fn)
+        p.ops = list(self.ops)
+        p.feeds = dict(self.feeds)
+        return p
+
+    def is_empty(self):
+        return not self.ops and self._fn is None
 
 
-def default_main_program():
-    return Program()
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[Program] = []
 
 
-def default_startup_program():
-    return Program()
+def default_main_program() -> Program:
+    return _guard_stack[-1] if _guard_stack else _default_main
 
 
-class Executor:
-    """Runs captured callables (reference: python/paddle/base/executor.py —
-    but execution is jax.jit, so 'run' is a function call)."""
+def default_startup_program() -> Program:
+    return _default_startup
 
-    def __init__(self, place=None):
-        self.place = place
 
-    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        if program is None or program._fn is None:
-            return []
-        import jax
+class program_guard:
+    """Route recording into ``main`` (reference: static.program_guard).
+    ``startup`` is accepted for API parity but stays empty: parameter
+    initialization is eager at Layer construction (see module docstring)."""
 
-        out = program._fn(**(feed or {}))
-        out = out if isinstance(out, (list, tuple)) else [out]
-        return [jax.device_get(getattr(o, "_data", o)) for o in out]
+    def __init__(self, main: Program, startup: Optional[Program] = None):
+        self.main = main
+        self.startup = startup
+
+    def __enter__(self):
+        _guard_stack.append(self.main)
+        return self.main
+
+    def __exit__(self, *exc):
+        _guard_stack.pop()
+        return False
+
+
+def _maybe_record(fn, inputs, kwargs, outputs):
+    """Called by framework.tensor.apply_op when static mode is on."""
+    if _static_mode:
+        default_main_program()._record(fn, inputs, kwargs, outputs)
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    return InputSpec(shape, dtype, name)
+    """Declare a feed variable: a named placeholder Tensor recorded in the
+    current program (None dims become 1 for the capture trace; Executor.run
+    replays with the real fed shapes)."""
+    import jax.numpy as jnp
+
+    from ..framework.tensor import Tensor
+
+    cap_shape = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+    t = Tensor(jnp.zeros(cap_shape, dtype))
+    t.name = name
+    default_main_program().feeds[name] = t
+    return t
+
+
+class Executor:
+    """Replays a Program as one jitted function of the feeds AND the current
+    parameter values (reference: python/paddle/base/executor.py; execution
+    engine = XLA). Parameters are runtime inputs, not trace-time constants:
+    updating weights (training, ``set_state_dict``) between runs is
+    reflected without retracing."""
+
+    def __init__(self, place=None):
+        self.place = place
+        # values hold strong refs to (program, fetch_list, params) so the
+        # id-based key can never alias a recycled object
+        self._compiled: Dict[tuple, tuple] = {}
+
+    @staticmethod
+    def _param_tensors(program: Program):
+        """Distinct non-placeholder Tensor inputs across the program's ops,
+        in first-use order — the replay's runtime parameter slots."""
+        feed_ids = {id(t) for t in program.feeds.values()}
+        produced = {id(o) for _, _, _, outs in program.ops for o in outs}
+        seen, params = set(), []
+        for _, inputs, _, _ in program.ops:
+            for t in inputs:
+                if (hasattr(t, "_data") and id(t) not in feed_ids
+                        and id(t) not in produced and id(t) not in seen):
+                    seen.add(id(t))
+                    params.append(t)
+        return params
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True, **kwargs):
+        import jax
+
+        program = program if program is not None else default_main_program()
+        feed = feed or {}
+        if program._fn is not None:  # legacy captured-callable programs
+            out = program._fn(**feed)
+            out = out if isinstance(out, (list, tuple)) else [out]
+            return [jax.device_get(getattr(o, "_data", o)) for o in out]
+        if not program.ops:
+            return []
+        fetch_list = fetch_list or []
+
+        missing = sorted(set(program.feeds) - set(feed))
+        if missing:
+            raise KeyError(
+                f"Executor.run: feed is missing declared variables {missing}"
+            )
+        feed_names = tuple(sorted(feed))
+        feed_arrays = [jax.numpy.asarray(feed[k]) for k in feed_names]
+        key = (id(program), len(program.ops), feed_names,
+               tuple(a.shape for a in feed_arrays),
+               tuple(id(f) for f in fetch_list))
+        entry = self._compiled.get(key)
+        if entry is None:
+            params = self._param_tensors(program)
+            run_fn = jax.jit(self._make_replay(program, feed_names,
+                                               fetch_list, params))
+            entry = (program, tuple(fetch_list), params, run_fn)
+            self._compiled[key] = entry
+        _, _, params, run_fn = entry
+        outs = run_fn(feed_arrays, [p._data for p in params])
+        if return_numpy:
+            import numpy as np
+
+            return [np.asarray(jax.device_get(o)) for o in outs]
+        return list(outs)
+
+    @staticmethod
+    def _make_replay(program: Program, feed_names, fetch_list, params):
+        def replay(feed_arrays, param_arrays):
+            env = {}
+            for name, arr in zip(feed_names, feed_arrays):
+                ph = program.feeds.get(name)
+                if ph is not None:
+                    env[id(ph)] = arr
+            for t, arr in zip(params, param_arrays):
+                env[id(t)] = arr
+
+            def val(t):
+                if id(t) in env:
+                    return env[id(t)]
+                return getattr(t, "_data", t)
+
+            for fn, inputs, kw, outputs in program.ops:
+                outs = fn(*[val(i) for i in inputs], **kw)
+                outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+                for o_t, o in zip(outputs, outs):
+                    env[id(o_t)] = o
+            return [val(f) for f in fetch_list]
+
+        return replay

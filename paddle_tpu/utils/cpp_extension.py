@@ -14,7 +14,7 @@ import ctypes
 import os
 from typing import Optional, Sequence
 
-__all__ = ["load", "CppExtension", "CUDAExtension"]
+__all__ = ["load", "load_ffi", "CppExtension", "CUDAExtension"]
 
 
 def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
@@ -72,6 +72,59 @@ def load(name: str, sources: Sequence[str], extra_cxx_cflags=None,
             if not os.path.exists(so):
                 raise
     return ctypes.CDLL(so)
+
+
+def load_ffi(name: str, sources: Sequence[str], functions: Sequence[str],
+             platform: str = "cpu", **load_kwargs):
+    """Compile C++ ``sources`` implementing XLA FFI handlers and register
+    each symbol in ``functions`` as an XLA custom-call target — the
+    registration path the reference provides through paddle/phi/capi
+    (SURVEY.md A7: out-of-tree kernels entering dispatch) and
+    op_meta_info.h custom ops (A25), here entering XLA's dispatch so the op
+    is usable INSIDE jit.
+
+    Handlers use the jaxlib-shipped headers (xla/ffi/api/ffi.h +
+    XLA_FFI_DEFINE_HANDLER_SYMBOL); targets are registered as
+    ``{name}.{function}``. Returns ``{function: caller}`` where
+    ``caller(result_shape_dtypes, *args, **attrs)`` invokes
+    ``jax.ffi.ffi_call``. ``platform`` is "cpu": XLA custom calls execute on
+    the host even in TPU programs (TPU device code stays Pallas)."""
+    import jax
+
+    inc = list(load_kwargs.pop("extra_include_paths", []) or [])
+    inc.append(jax.ffi.include_dir())
+    lib = load(name, sources, extra_include_paths=inc, **load_kwargs)
+
+    callers = {}
+    for fn_name in functions:
+        sym = getattr(lib, fn_name)
+        target = f"{name}.{fn_name}"
+        # XLA rejects re-registering a target name at a different address;
+        # same build → reuse, different build of the same name → a
+        # uniquified target (the reference's registry similarly keys on the
+        # registering module)
+        seen = _ffi_registry.get((target, platform))
+        if seen is not None and seen != lib._name:
+            n = 1
+            while _ffi_registry.get((f"{target}#{n}", platform),
+                                    lib._name) != lib._name:
+                n += 1
+            target = f"{target}#{n}"
+            seen = _ffi_registry.get((target, platform))
+        if seen is None:
+            jax.ffi.register_ffi_target(target, jax.ffi.pycapsule(sym),
+                                        platform=platform)
+            _ffi_registry[(target, platform)] = lib._name
+
+        def caller(result_shape_dtypes, *args, _target=target, **attrs):
+            return jax.ffi.ffi_call(_target, result_shape_dtypes)(
+                *args, **attrs)
+
+        callers[fn_name] = caller
+    return callers
+
+
+_ffi_registry: dict = {}
 
 
 class CppExtension:

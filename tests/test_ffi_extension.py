@@ -1,0 +1,72 @@
+"""XLA FFI custom-call registration tests (SURVEY.md A7/A25; reference:
+paddle/phi/capi kernel registration + utils/cpp_extension custom ops —
+out-of-tree native code entering compiled-graph dispatch)."""
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.utils.cpp_extension import load_ffi
+
+AXPY_CC = textwrap.dedent("""
+    #include "xla/ffi/api/ffi.h"
+
+    namespace ffi = xla::ffi;
+
+    static ffi::Error AxpyImpl(ffi::Buffer<ffi::F32> x,
+                               ffi::Buffer<ffi::F32> y,
+                               ffi::Result<ffi::Buffer<ffi::F32>> out,
+                               float alpha) {
+      const size_t n = x.element_count();
+      const float* xd = x.typed_data();
+      const float* yd = y.typed_data();
+      float* od = out->typed_data();
+      for (size_t i = 0; i < n; ++i) od[i] = alpha * xd[i] + yd[i];
+      return ffi::Error::Success();
+    }
+
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(
+        Axpy, AxpyImpl,
+        ffi::Ffi::Bind()
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Ret<ffi::Buffer<ffi::F32>>()
+            .Attr<float>("alpha"));
+""")
+
+
+@pytest.fixture(scope="module")
+def axpy(tmp_path_factory):
+    src = tmp_path_factory.mktemp("ffi") / "axpy.cc"
+    src.write_text(AXPY_CC)
+    try:
+        return load_ffi("test_axpy", [str(src)], functions=["Axpy"])
+    except RuntimeError as e:  # no toolchain — the ctypes path covers load()
+        pytest.skip(f"toolchain unavailable: {e}")
+
+
+class TestFFIExtension:
+    def test_custom_call_inside_jit(self, axpy, rng):
+        x = jnp.asarray(rng.standard_normal(16), jnp.float32)
+        y = jnp.asarray(rng.standard_normal(16), jnp.float32)
+
+        @jax.jit
+        def f(x, y):
+            out = axpy["Axpy"](jax.ShapeDtypeStruct(x.shape, x.dtype),
+                               x, y, alpha=np.float32(2.0))
+            return out * 3.0  # composes with XLA ops around the call
+
+        np.testing.assert_allclose(np.asarray(f(x, y)),
+                                   (2 * np.asarray(x) + np.asarray(y)) * 3,
+                                   rtol=1e-6)
+
+    def test_reregistration_is_idempotent(self, axpy, tmp_path):
+        src = tmp_path / "axpy2.cc"
+        src.write_text(AXPY_CC)
+        again = load_ffi("test_axpy", [str(src)], functions=["Axpy"])
+        x = jnp.ones(4, jnp.float32)
+        out = again["Axpy"](jax.ShapeDtypeStruct(x.shape, x.dtype),
+                            x, x, alpha=np.float32(1.0))
+        np.testing.assert_allclose(np.asarray(out), 2.0)

@@ -1,0 +1,120 @@
+"""paddle.static capture/replay tests (reference: python/paddle/static/ —
+Program + Executor; SURVEY.md §3.4 "static mode = explicit capture",
+VERDICT r1 weak #8: the placeholder Program/Executor became a real recorded
+op list replayed as one jitted function)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, static
+
+
+@pytest.fixture(autouse=True)
+def _dynamic_after():
+    yield
+    paddle.disable_static()
+
+
+class TestStaticCapture:
+    def test_classic_workflow(self, rng):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        main = static.Program()
+        paddle.enable_static()
+        with static.program_guard(main):
+            x = static.data("x", [None, 8])
+            y = net(x)
+        paddle.disable_static()
+        assert not main.is_empty()
+
+        exe = static.Executor()
+        feed = rng.standard_normal((5, 8)).astype(np.float32)
+        out, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+        # twin: eager forward
+        want = np.asarray(net(paddle.to_tensor(feed))._data)
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_replay_with_different_batch_size(self, rng):
+        net = nn.Linear(6, 3)
+        main = static.Program()
+        paddle.enable_static()
+        with static.program_guard(main):
+            x = static.data("x", [None, 6])
+            y = net(x)
+        paddle.disable_static()
+        exe = static.Executor()
+        for bsz in (1, 4, 9):
+            feed = rng.standard_normal((bsz, 6)).astype(np.float32)
+            out, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+            want = np.asarray(net(paddle.to_tensor(feed))._data)
+            np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+    def test_program_guard_isolation(self, rng):
+        net = nn.Linear(4, 2)
+        p1, p2 = static.Program(), static.Program()
+        paddle.enable_static()
+        with static.program_guard(p1):
+            x1 = static.data("x", [None, 4])
+            net(x1)
+        n1 = len(p1.ops)
+        with static.program_guard(p2):
+            x2 = static.data("x", [None, 4])
+            net(net(x2).reshape([-1, 2]).matmul(
+                paddle.to_tensor(np.ones((2, 4), np.float32))))
+        paddle.disable_static()
+        assert len(p1.ops) == n1  # nothing leaked into p1
+        assert len(p2.ops) > n1
+
+    def test_multiple_feeds_and_fetches(self, rng):
+        main = static.Program()
+        paddle.enable_static()
+        with static.program_guard(main):
+            a = static.data("a", [None, 3])
+            b = static.data("b", [None, 3])
+            s = a + b
+            d = a * b
+        paddle.disable_static()
+        exe = static.Executor()
+        av = rng.standard_normal((2, 3)).astype(np.float32)
+        bv = rng.standard_normal((2, 3)).astype(np.float32)
+        s_out, d_out = exe.run(main, feed={"a": av, "b": bv},
+                               fetch_list=[s, d])
+        np.testing.assert_allclose(s_out, av + bv, rtol=1e-6)
+        np.testing.assert_allclose(d_out, av * bv, rtol=1e-6)
+
+    def test_dynamic_mode_records_nothing(self, rng):
+        before = len(static.default_main_program().ops)
+        x = paddle.to_tensor(rng.standard_normal((2, 2)).astype(np.float32))
+        _ = x + x
+        assert len(static.default_main_program().ops) == before
+
+    def test_param_updates_reflected_between_runs(self, rng):
+        """Weights are runtime inputs to the replay, not baked constants."""
+        net = nn.Linear(4, 2)
+        main = static.Program()
+        paddle.enable_static()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4])
+            y = net(x)
+        paddle.disable_static()
+        exe = static.Executor()
+        feed = rng.standard_normal((3, 4)).astype(np.float32)
+        out1, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+        import jax.numpy as jnp
+
+        net.weight._data = jnp.zeros_like(net.weight._data)
+        net.bias._data = jnp.full_like(net.bias._data, 7.0)
+        out2, = exe.run(main, feed={"x": feed}, fetch_list=[y])
+        np.testing.assert_allclose(out2, 7.0)
+        assert not np.allclose(out1, out2)
+
+    def test_missing_feed_raises(self, rng):
+        main = static.Program()
+        paddle.enable_static()
+        with static.program_guard(main):
+            a = static.data("a", [None, 3])
+            b = a * 2.0
+        paddle.disable_static()
+        exe = static.Executor()
+        with pytest.raises(KeyError, match="missing declared"):
+            exe.run(main, feed={"wrong": np.ones((1, 3), np.float32)},
+                    fetch_list=[b])

@@ -4,6 +4,7 @@ what survives is the dygraph hybrid optimizer glue)."""
 from .dygraph_optimizer import (  # noqa: F401
     DygraphShardingOptimizer,
     GradientMergeOptimizer,
+    LocalSGDOptimizer,
     HybridParallelGradScaler,
     HybridParallelOptimizer,
 )
@@ -13,4 +14,5 @@ __all__ = [
     "HybridParallelGradScaler",
     "DygraphShardingOptimizer",
     "GradientMergeOptimizer",
+    "LocalSGDOptimizer",
 ]

@@ -228,3 +228,42 @@ class GradientMergeOptimizer:
 
     def __getattr__(self, item):
         return getattr(self.__dict__["_inner_opt"], item)
+
+
+class LocalSGDOptimizer:
+    """Local SGD (reference: fleet/meta_optimizers/localsgd_optimizer.py via
+    strategy.localsgd={"k_steps": k}): workers step on LOCAL gradients and
+    synchronize by averaging PARAMETERS every ``k_steps`` instead of
+    all-reducing gradients every step — the comm-frequency/quality trade.
+
+    Use with a DataParallel model under ``no_sync()`` (or a plain model in a
+    multi-process world): this wrapper owns the only cross-worker traffic."""
+
+    def __init__(self, optimizer, k_steps: int = 1, group=None):
+        self._inner_opt = optimizer
+        self._k_steps = max(1, int(k_steps))
+        self._group = group
+        self._step_count = 0
+
+    def step(self):
+        self._inner_opt.step()
+        self._step_count += 1
+        if self._step_count % self._k_steps == 0:
+            self._sync_params()
+
+    def _sync_params(self):
+        from ....distributed import collective
+        from ....distributed.parallel import _env
+
+        if _env.world_size <= 1:
+            return
+        for p in self._inner_opt._parameter_list():
+            collective.all_reduce(p, op=collective.ReduceOp.AVG,
+                                  group=self._group)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)

@@ -5,3 +5,4 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 
 __all__ = ["nn"]
+from . import asp  # noqa: F401

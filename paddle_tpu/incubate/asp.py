@@ -1,0 +1,114 @@
+"""Automatic SParsity — 2:4 structured sparsity (reference:
+python/paddle/incubate/asp/ + fleet/meta_optimizers/asp_optimizer.py:
+prune weights to n-of-m pattern, then keep the mask fixed through training
+by masking weights after every optimizer step).
+
+TPU note: the reference's payoff is Ampere sparse-tensor-core GEMMs; XLA has
+no 2:4 MXU path, so here ASP is a MODEL-QUALITY feature (train a sparse
+network, export it) with the same API. Masks live per-parameter; the
+decorated optimizer re-applies them after each step so pruned weights stay
+exactly zero.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["calculate_density", "compute_mask_2to4", "prune_model",
+           "decorate", "ASPOptimizer"]
+
+# id(param) -> (weakref(param), mask). The weakref is VALIDATED on lookup:
+# CPython recycles ids, so a bare id-keyed dict could hand a dead
+# parameter's mask to an unrelated new object.
+_MASKS: Dict[int, tuple] = {}
+
+
+def _mask_for(p):
+    entry = _MASKS.get(id(p))
+    if entry is None:
+        return None
+    ref, mask = entry
+    if ref() is not p:  # stale id from a collected parameter
+        del _MASKS[id(p)]
+        return None
+    return mask
+
+
+def _register_mask(p, mask):
+    _MASKS[id(p)] = (weakref.ref(p), mask)
+
+
+def compute_mask_2to4(w, n: int = 2, m: int = 4, axis: int = -1):
+    """Keep the ``n`` largest-magnitude entries of every group of ``m``
+    along ``axis``. The 1-D n:m pattern must run along the GEMM reduction
+    dim to be consumable by sparse-tensor-core GEMMs; for this framework's
+    ``[in_features, out_features]`` Linear weights that is axis 0 (what
+    ``prune_model`` passes)."""
+    w = jnp.moveaxis(w, axis, -1)
+    if w.shape[-1] % m:
+        mask = jnp.ones_like(w, dtype=bool)
+    else:
+        g = w.reshape(w.shape[:-1] + (w.shape[-1] // m, m))
+        order = jnp.argsort(jnp.abs(g), axis=-1)  # ascending
+        ranks = jnp.argsort(order, axis=-1)  # rank of each entry per group
+        mask = (ranks >= (m - n)).reshape(w.shape)
+    return jnp.moveaxis(mask, -1, axis)
+
+
+def calculate_density(x) -> float:
+    import numpy as np
+
+    a = np.asarray(getattr(x, "_data", x))
+    return float((a != 0).sum() / a.size)
+
+
+def _prunable(name: str, p) -> bool:
+    return len(p.shape) == 2 and not getattr(p, "is_bias", False)
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune every 2-D weight of ``model`` to the n:m pattern and register
+    its mask (reference: paddle.incubate.asp.prune_model)."""
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        # axis 0 = in_features = the y = xW reduction dim
+        mask = compute_mask_2to4(p._data, n=n, m=m, axis=0)
+        p._data = jnp.where(mask, p._data, 0)
+        if with_mask:
+            _register_mask(p, mask)
+            masks[name] = mask
+    return masks
+
+
+class ASPOptimizer:
+    """Masked optimizer wrapper: after each inner step, re-zero pruned
+    entries so the sparsity pattern survives updates (reference:
+    asp_optimizer.py OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer, model=None):
+        self._inner_opt = optimizer
+        self._model = model
+
+    def step(self):
+        self._inner_opt.step()
+        for p in self._inner_opt._parameter_list():
+            mask = _mask_for(p)
+            if mask is not None:
+                p._data = jnp.where(mask, p._data, 0)
+
+    def minimize(self, loss, *a, **k):
+        loss.backward()
+        self.step()
+
+    def __getattr__(self, item):
+        return getattr(self.__dict__["_inner_opt"], item)
+
+
+def decorate(optimizer, model: Optional[object] = None) -> ASPOptimizer:
+    """paddle.incubate.asp.decorate parity."""
+    return ASPOptimizer(optimizer, model)

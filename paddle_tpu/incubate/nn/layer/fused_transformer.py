@@ -316,14 +316,12 @@ class FusedMultiTransformer(nn.Layer):
             # paged/block cache (serving path): the manager mutates host-side
             # block tables and functional page arrays; inference-only (no
             # tape node — gradients don't flow through a serving cache)
+            from ....ops.pallas.paged_attention import paged_forward
+
             unwrap = lambda t: t._data if isinstance(t, Tensor) else t
-            qd, kd, vd = unwrap(q), unwrap(k), unwrap(v)
-            if time_step is None:
-                cache.prefill(kd, vd)  # [b, s, nh, hd]
-                out = ctx_attention()
-            else:
-                cache.append(kd[:, 0], vd[:, 0])
-                out = Tensor._wrap(cache.attend(qd[:, 0])[:, None])
+            res = paged_forward(cache, unwrap(q), unwrap(k), unwrap(v),
+                                time_step, ctx_attention)
+            out = res if isinstance(res, Tensor) else Tensor._wrap(res)
             new_cache = cache
         elif time_step is None:
             # context phase: write prompt k/v at positions [0, s)

@@ -97,6 +97,17 @@ class GPTAttention(nn.Layer):
                 q, k, v, dropout=self.attn_dropout, causal=True,
                 training=self.training,
             )
+        elif type(cache).__name__ == "PagedKVCache":
+            # serving path: block-table page pool
+            from ..ops.pallas.paged_attention import paged_forward
+
+            unwrap = lambda t: t._data if isinstance(t, Tensor) else t
+            res = paged_forward(
+                cache, unwrap(q), unwrap(k), unwrap(v), time_step,
+                lambda: F.flash_attention(q, k, v, causal=True,
+                                          training=False)[0])
+            out = res if isinstance(res, Tensor) else Tensor._wrap(res)
+            new_cache = cache
         elif time_step is None:
             # prefill: causal attention over the prompt, cache k/v at [0, s)
             from ..ops.pallas.decode_attention import cache_prefill_write

@@ -120,6 +120,17 @@ class LlamaAttention(nn.Layer):
         if cache is None:
             out, _ = F.flash_attention(q, expand_kv(k), expand_kv(v),
                                        causal=True, training=self.training)
+        elif type(cache).__name__ == "PagedKVCache":
+            # serving path: block-table page pool (GQA native in the kernel)
+            from ..ops.pallas.paged_attention import paged_forward
+
+            unwrap = lambda t: t._data if isinstance(t, Tensor) else t
+            res = paged_forward(
+                cache, unwrap(q), unwrap(k), unwrap(v), time_step,
+                lambda: F.flash_attention(q, expand_kv(k), expand_kv(v),
+                                          causal=True, training=False)[0])
+            out = res if isinstance(res, Tensor) else Tensor._wrap(res)
+            new_cache = cache
         elif time_step is None:
             from ..ops.pallas.decode_attention import cache_prefill_write
 

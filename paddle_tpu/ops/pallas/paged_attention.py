@@ -326,3 +326,28 @@ class PagedKVCache:
         return fn(q, self.k_pages, self.v_pages,
                   jnp.asarray(self.block_tables), jnp.asarray(self.lengths),
                   k_scales=self.k_scales, v_scales=self.v_scales)
+
+
+def paged_forward(cache: "PagedKVCache", q, k, v, time_step,
+                  context_attention):
+    """Shared model-side paged-cache step (one copy for every attention
+    layer — GPT, LLaMA, FusedMultiTransformer). Eager/serving only: the
+    manager mutates host-side block tables.
+
+    ``q/k/v``: [b, s, heads, head_dim] raw arrays. Prefill (``time_step``
+    None) writes the prompt and returns ``context_attention()``'s result;
+    decode appends one token and attends over the pages. Decode validates
+    that the caller's ``time_step`` equals the cache length — a replayed or
+    skipped step corrupts a paged cache silently (append ≠ overwrite), so
+    the disagreement must be an error."""
+    if time_step is None:
+        cache.prefill(k, v)
+        return context_attention()
+    ts = int(time_step)
+    if int(cache.lengths[0]) != ts:
+        raise ValueError(
+            f"paged decode at time_step={ts} but cache holds "
+            f"{int(cache.lengths[0])} tokens — paged caches append; replay/"
+            "skip requires free()+prefill (contiguous caches overwrite)")
+    cache.append(k[:, 0], v[:, 0])
+    return cache.attend(q[:, 0])[:, None]

@@ -1,0 +1,73 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py)."""
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121"]
+
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_ch, growth, bn_size):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_ch)
+        self.conv1 = nn.Conv2D(in_ch, bn_size * growth, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth)
+        self.conv2 = nn.Conv2D(bn_size * growth, growth, 3, padding=1,
+                               bias_attr=False)
+
+    def forward(self, x):
+        from ...nn import functional as F
+        import paddle_tpu as paddle
+
+        y = self.conv1(F.relu(self.norm1(x)))
+        y = self.conv2(F.relu(self.norm2(y)))
+        return paddle.concat([x, y], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_ch)
+        self.conv = nn.Conv2D(in_ch, out_ch, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        return self.pool(self.conv(F.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=(6, 12, 24, 16), growth=32, bn_size=4,
+                 num_classes=1000, num_init_features=64):
+        super().__init__()
+        self.num_classes = num_classes
+        feats = [
+            nn.Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                      bias_attr=False),
+            nn.BatchNorm2D(num_init_features), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+        ]
+        ch = num_init_features
+        for i, n in enumerate(layers):
+            for _ in range(n):
+                feats.append(_DenseLayer(ch, growth, bn_size))
+                ch += growth
+            if i != len(layers) - 1:
+                feats.append(_Transition(ch, ch // 2))
+                ch //= 2
+        feats += [nn.BatchNorm2D(ch), nn.ReLU()]
+        self.features = nn.Sequential(*feats)
+        self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = nn.Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(x.flatten(1))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return DenseNet((6, 12, 24, 16), **kwargs)

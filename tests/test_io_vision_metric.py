@@ -195,3 +195,38 @@ class TestDeviceNS:
             a = unique_name.generate("fc")
             b = unique_name.generate("fc")
         assert a != b
+
+
+class TestVisionZooAdditions:
+    """AlexNet / SqueezeNet / DenseNet parity additions (reference:
+    python/paddle/vision/models/{alexnet,squeezenet,densenet}.py)."""
+
+    @pytest.mark.parametrize("builder,size", [
+        ("alexnet", 224), ("squeezenet1_1", 224),
+    ])
+    def test_forward_shapes(self, rng, builder, size):
+        from paddle_tpu.vision import models
+
+        net = getattr(models, builder)(num_classes=10)
+        net.eval()
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, size, size)).astype(np.float32))
+        out = net(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_densenet_tiny(self, rng):
+        from paddle_tpu.vision.models import DenseNet
+
+        net = DenseNet(layers=(2, 2), growth=8, bn_size=2, num_classes=5,
+                       num_init_features=16)
+        net.eval()
+        x = paddle.to_tensor(
+            rng.standard_normal((2, 3, 64, 64)).astype(np.float32))
+        out = net(x)
+        assert tuple(out.shape) == (2, 5)
+        # train-mode backward reaches all params
+        net.train()
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        missing = [n for n, p in net.named_parameters() if p.grad is None]
+        assert not missing, missing

@@ -90,3 +90,29 @@ class TestLlamaForward:
         # position 0 is the identity rotation
         np.testing.assert_allclose(np.asarray(q0._data),
                                    np.asarray(q._data), atol=1e-5)
+
+    def test_paged_cache_matches_contiguous(self, model, ids):
+        """Serving path: paged block-table caches must produce the same
+        decode logits as the contiguous [2,b,nkv,S,hd] caches."""
+        from paddle_tpu.ops.pallas import PagedKVCache
+
+        model.eval()
+        cfg = model.config
+        cont = model.init_caches(2, 32)
+        paged = [PagedKVCache(num_pages=16, page_size=8, batch_size=2,
+                              num_kv_heads=cfg.num_kv_heads,
+                              head_dim=cfg.head_dim, max_pages_per_seq=4,
+                              dtype=jnp.float32)
+                 for _ in range(cfg.num_layers)]
+        lg1, cont = model(Tensor._wrap(ids[:, :6]), caches=cont)
+        lg2, paged = model(Tensor._wrap(ids[:, :6]), caches=paged)
+        np.testing.assert_allclose(np.asarray(lg1._data),
+                                   np.asarray(lg2._data), atol=1e-5)
+        for t in range(6, 9):
+            d1, cont = model(Tensor._wrap(ids[:, t:t + 1]), caches=cont,
+                             time_step=t)
+            d2, paged = model(Tensor._wrap(ids[:, t:t + 1]), caches=paged,
+                              time_step=t)
+            np.testing.assert_allclose(np.asarray(d1._data),
+                                       np.asarray(d2._data), atol=1e-4,
+                                       err_msg=f"t={t}")

@@ -37,7 +37,12 @@ def _mask_for(p):
 
 
 def _register_mask(p, mask):
-    _MASKS[id(p)] = (weakref.ref(p), mask)
+    key = id(p)
+
+    def _purge(_ref, _key=key):
+        _MASKS.pop(_key, None)  # free the mask when the parameter dies
+
+    _MASKS[key] = (weakref.ref(p, _purge), mask)
 
 
 def compute_mask_2to4(w, n: int = 2, m: int = 4, axis: int = -1):
@@ -64,17 +69,31 @@ def calculate_density(x) -> float:
     return float((a != 0).sum() / a.size)
 
 
-def _prunable(name: str, p) -> bool:
-    return len(p.shape) == 2 and not getattr(p, "is_bias", False)
+def _prunable_weights(model):
+    """GEMM weights only — Linear layers' 2-D kernels (reference ASP prunes
+    FC/Conv, never embeddings: an n:m pattern across unrelated vocabulary
+    rows destroys quality with no sparse-GEMM payoff)."""
+    from .. import nn
+
+    for layer_name, layer in [("", model)] + list(model.named_sublayers()):
+        if isinstance(layer, nn.Linear):
+            prefix = f"{layer_name}." if layer_name else ""
+            yield f"{prefix}weight", layer.weight
 
 
 def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
                 with_mask: bool = True):
-    """Prune every 2-D weight of ``model`` to the n:m pattern and register
-    its mask (reference: paddle.incubate.asp.prune_model)."""
+    """Prune every Linear weight of ``model`` to the n:m pattern and
+    register its mask (reference: paddle.incubate.asp.prune_model)."""
+    import warnings
+
     masks = {}
-    for name, p in model.named_parameters():
-        if not _prunable(name, p):
+    for name, p in _prunable_weights(model):
+        if p.shape[0] % m:
+            warnings.warn(
+                f"asp.prune_model: {name} has in_features={p.shape[0]} not "
+                f"divisible by {m} — left dense (no mask registered)",
+                RuntimeWarning, stacklevel=2)
             continue
         # axis 0 = in_features = the y = xW reduction dim
         mask = compute_mask_2to4(p._data, n=n, m=m, axis=0)
@@ -92,11 +111,15 @@ class ASPOptimizer:
 
     def __init__(self, optimizer, model=None):
         self._inner_opt = optimizer
-        self._model = model
+        # optional scope: only this model's parameters get re-masked
+        self._scope_ids = (None if model is None else
+                           {id(p) for _, p in model.named_parameters()})
 
     def step(self):
         self._inner_opt.step()
         for p in self._inner_opt._parameter_list():
+            if self._scope_ids is not None and id(p) not in self._scope_ids:
+                continue
             mask = _mask_for(p)
             if mask is not None:
                 p._data = jnp.where(mask, p._data, 0)

@@ -318,9 +318,7 @@ class FusedMultiTransformer(nn.Layer):
             # tape node — gradients don't flow through a serving cache)
             from ....ops.pallas.paged_attention import paged_forward
 
-            unwrap = lambda t: t._data if isinstance(t, Tensor) else t
-            res = paged_forward(cache, unwrap(q), unwrap(k), unwrap(v),
-                                time_step, ctx_attention)
+            res = paged_forward(cache, q, k, v, time_step, ctx_attention)
             out = res if isinstance(res, Tensor) else Tensor._wrap(res)
             new_cache = cache
         elif time_step is None:

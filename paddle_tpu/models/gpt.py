@@ -75,6 +75,14 @@ def gpt3_6p7b():
     )
 
 
+def _is_paged(cache) -> bool:
+    """isinstance check with a lazy import (isinstance — not a name compare —
+    so PagedKVCache subclasses dispatch correctly)."""
+    from ..ops.pallas.paged_attention import PagedKVCache
+
+    return isinstance(cache, PagedKVCache)
+
+
 class GPTAttention(nn.Layer):
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -97,13 +105,12 @@ class GPTAttention(nn.Layer):
                 q, k, v, dropout=self.attn_dropout, causal=True,
                 training=self.training,
             )
-        elif type(cache).__name__ == "PagedKVCache":
+        elif _is_paged(cache):
             # serving path: block-table page pool
             from ..ops.pallas.paged_attention import paged_forward
 
-            unwrap = lambda t: t._data if isinstance(t, Tensor) else t
             res = paged_forward(
-                cache, unwrap(q), unwrap(k), unwrap(v), time_step,
+                cache, q, k, v, time_step,
                 lambda: F.flash_attention(q, k, v, causal=True,
                                           training=False)[0])
             out = res if isinstance(res, Tensor) else Tensor._wrap(res)

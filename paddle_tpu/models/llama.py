@@ -74,6 +74,14 @@ def tiny_llama_config(**kw):
     return LlamaConfig(**base)
 
 
+def _is_paged(cache) -> bool:
+    """isinstance check with a lazy import (isinstance — not a name compare —
+    so PagedKVCache subclasses dispatch correctly)."""
+    from ..ops.pallas.paged_attention import PagedKVCache
+
+    return isinstance(cache, PagedKVCache)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -120,13 +128,12 @@ class LlamaAttention(nn.Layer):
         if cache is None:
             out, _ = F.flash_attention(q, expand_kv(k), expand_kv(v),
                                        causal=True, training=self.training)
-        elif type(cache).__name__ == "PagedKVCache":
+        elif _is_paged(cache):
             # serving path: block-table page pool (GQA native in the kernel)
             from ..ops.pallas.paged_attention import paged_forward
 
-            unwrap = lambda t: t._data if isinstance(t, Tensor) else t
             res = paged_forward(
-                cache, unwrap(q), unwrap(k), unwrap(v), time_step,
+                cache, q, k, v, time_step,
                 lambda: F.flash_attention(q, expand_kv(k), expand_kv(v),
                                           causal=True, training=False)[0])
             out = res if isinstance(res, Tensor) else Tensor._wrap(res)

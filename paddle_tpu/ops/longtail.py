@@ -673,3 +673,247 @@ def unique_consecutive(x, return_inverse=False, return_counts=False,
         counts = np.diff(np.append(idx, moved.shape[0]))
         rets.append(Tensor(jnp.asarray(counts)))
     return rets[0] if len(rets) == 1 else tuple(rets)
+
+
+# ------------------------------------------------- batch 2 (round-2 late)
+# stacking/layout aliases, statistics, membership, sampling — the next tier
+# of python/paddle/tensor functions, numpy-checked in tests/test_op_longtail.py
+
+__all__ += [
+    "hstack", "vstack", "dstack", "column_stack", "row_stack",
+    "atleast_1d", "atleast_2d", "atleast_3d", "unflatten",
+    "broadcast_tensors", "block_diag", "pad",
+    "argwhere", "nanargmax", "nanargmin", "isin", "digitize",
+    "histogram_bin_edges", "corrcoef", "cov", "cdist", "pdist",
+    "cartesian_prod", "combinations", "index_fill", "increment", "crop",
+    "multinomial", "bernoulli", "poisson", "standard_normal",
+]
+
+
+def _stacklike(fn, inputs):
+    # variadic apply_op keeps every stacked input on the autograd tape
+    # (same pattern as ops/manipulation.py concat/stack)
+    return apply_op(lambda *arrs: fn(list(arrs)), *[_t(x) for x in inputs])
+
+
+def hstack(x, name=None):
+    return _stacklike(jnp.hstack, x)
+
+
+def vstack(x, name=None):
+    return _stacklike(jnp.vstack, x)
+
+
+def dstack(x, name=None):
+    return _stacklike(jnp.dstack, x)
+
+
+def column_stack(x, name=None):
+    return _stacklike(jnp.column_stack, x)
+
+
+def row_stack(x, name=None):
+    return _stacklike(jnp.vstack, x)
+
+
+def atleast_1d(*inputs):
+    outs = [Tensor(jnp.atleast_1d(_t(x)._data)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs):
+    outs = [Tensor(jnp.atleast_2d(_t(x)._data)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs):
+    outs = [Tensor(jnp.atleast_3d(_t(x)._data)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def unflatten(x, axis, shape):
+    def fn(a):
+        ax = axis % a.ndim
+        new = tuple(a.shape[:ax]) + tuple(shape) + tuple(a.shape[ax + 1:])
+        return a.reshape(new)
+
+    return _u(fn, x)
+
+
+def broadcast_tensors(inputs, name=None):
+    outs = apply_op(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)),
+                    *[_t(x) for x in inputs])
+    return list(outs)
+
+
+def block_diag(inputs, name=None):
+    import jax.scipy.linalg as jsl
+
+    return apply_op(lambda *arrs: jsl.block_diag(*arrs),
+                    *[_t(x) for x in inputs])
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """Top-level paddle.pad: flat [before_last2, after_last2, ...] pairs from
+    the LAST axis backwards when len(pad)==2*k (paddle convention for the
+    nn.functional route), or per-axis pairs when given as nested pairs."""
+    from ..nn import functional as F
+
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def argwhere(x):
+    """Dynamic-shape op → evaluated on host, like nonzero."""
+    a = np.asarray(jax.device_get(_t(x)._data))
+    return Tensor(jnp.asarray(np.argwhere(a)))
+
+
+def nanargmax(x, axis=None, keepdim=False):
+    return _u(lambda a: jnp.nanargmax(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanargmin(x, axis=None, keepdim=False):
+    return _u(lambda a: jnp.nanargmin(a, axis=axis, keepdims=keepdim), x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return apply_op(lambda a, t: jnp.isin(a, t, invert=invert),
+                    _t(x), _t(test_x))
+
+
+def digitize(x, bins, right=False):
+    return apply_op(lambda a, b: jnp.digitize(a, b, right=right),
+                    _t(x), _t(bins))
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0):
+    def fn(a):
+        rng = None if (min == 0 and max == 0) else (min, max)
+        return jnp.histogram_bin_edges(a, bins=bins, range=rng)
+
+    return _u(fn, x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return _u(lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return _u(lambda a: jnp.cov(
+        a, rowvar=rowvar, ddof=1 if ddof else 0,
+        fweights=None if fweights is None else _t(fweights)._data,
+        aweights=None if aweights is None else _t(aweights)._data), x)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary"):
+    def fn(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1), 0.0))
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+
+    return apply_op(fn, _t(x), _t(y))
+
+
+def pdist(x, p=2.0):
+    def fn(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            full = jnp.sqrt(jnp.maximum(jnp.sum(d * d, axis=-1), 0.0))
+        else:
+            full = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return full[iu]
+
+    return _u(fn, x)
+
+
+def cartesian_prod(x, name=None):
+    def fn(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+
+    return apply_op(fn, *[_t(t) for t in x])
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+
+    n = _t(x)._data.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(it), np.int32).reshape(-1, r)
+    return _u(lambda a: a[idx], x)
+
+
+def index_fill(x, index, axis, value):
+    def fn(a, i):
+        am = jnp.moveaxis(a, axis, 0)
+        return jnp.moveaxis(am.at[i].set(value), 0, axis)
+
+    return apply_op(fn, _t(x), _t(index))
+
+
+def increment(x, value=1.0):
+    out = _u(lambda a: a + value, x)
+    if isinstance(x, Tensor):
+        x.set_value(out)
+        return x
+    return out
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def fn(a):
+        off = offsets or [0] * a.ndim
+        shp = [s if (s is not None and s > 0) else a.shape[i] - off[i]
+               for i, s in enumerate(shape or a.shape)]
+        # dynamic_slice silently clamps out-of-range starts — validate so an
+        # invalid region errors like the reference instead of shifting
+        for i, (o, sz) in enumerate(zip(off, shp)):
+            if o < 0 or o + sz > a.shape[i]:
+                raise ValueError(
+                    f"crop region [{o}, {o + sz}) out of bounds for axis "
+                    f"{i} with size {a.shape[i]}")
+        return jax.lax.dynamic_slice(a, off, shp)
+
+    return _u(fn, x)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    from ..framework import random as _random
+
+    probs = _t(x)._data
+    logits = jnp.log(jnp.maximum(probs, 1e-37))
+    key = _random.next_key()
+    if replacement:
+        out = jax.random.categorical(
+            key, logits, axis=-1, shape=(num_samples,) + probs.shape[:-1])
+        out = jnp.moveaxis(out, 0, -1)
+    else:
+        # Gumbel top-k = sampling without replacement
+        g = jax.random.gumbel(key, probs.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    from ..framework import random as _random
+
+    return _u(lambda a: jax.random.bernoulli(
+        _random.next_key(), a).astype(a.dtype), x)
+
+
+def poisson(x, name=None):
+    from ..framework import random as _random
+
+    return _u(lambda a: jax.random.poisson(
+        _random.next_key(), a).astype(a.dtype), x)
+
+
+def standard_normal(shape, dtype=None, name=None):
+    from ..framework import random as _random
+
+    return Tensor(jax.random.normal(
+        _random.next_key(), tuple(shape),
+        dtype or jnp.float32))

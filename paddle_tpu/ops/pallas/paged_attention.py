@@ -334,12 +334,14 @@ def paged_forward(cache: "PagedKVCache", q, k, v, time_step,
     layer — GPT, LLaMA, FusedMultiTransformer). Eager/serving only: the
     manager mutates host-side block tables.
 
-    ``q/k/v``: [b, s, heads, head_dim] raw arrays. Prefill (``time_step``
-    None) writes the prompt and returns ``context_attention()``'s result;
-    decode appends one token and attends over the pages. Decode validates
-    that the caller's ``time_step`` equals the cache length — a replayed or
-    skipped step corrupts a paged cache silently (append ≠ overwrite), so
-    the disagreement must be an error."""
+    ``q/k/v``: [b, s, heads, head_dim] Tensors or raw arrays (unwrapped
+    here — the callers share this glue). Prefill (``time_step`` None)
+    writes the prompt and returns ``context_attention()``'s result; decode
+    appends one token and attends over the pages. Decode validates that the
+    caller's ``time_step`` equals the cache length — a replayed or skipped
+    step corrupts a paged cache silently (append ≠ overwrite), so the
+    disagreement must be an error."""
+    q, k, v = (getattr(t, "_data", t) for t in (q, k, v))
     if time_step is None:
         cache.prefill(k, v)
         return context_attention()

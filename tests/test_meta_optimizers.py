@@ -96,3 +96,26 @@ class TestASP:
         for name, p in net.named_parameters():
             if len(p.shape) == 2:
                 assert abs(asp.calculate_density(p) - 0.5) < 1e-6, name
+
+    def test_embeddings_not_pruned(self, rng):
+        from paddle_tpu import nn as _nn
+
+        net = _nn.Sequential(_nn.Embedding(16, 8), _nn.Linear(8, 4))
+        asp.prune_model(net)
+        emb = [p for n_, p in net.named_parameters() if "0" in n_][0]
+        assert asp.calculate_density(emb) == 1.0  # embedding untouched
+        lin_w = net[1].weight
+        assert abs(asp.calculate_density(lin_w) - 0.5) < 1e-6
+
+    def test_non_divisible_warns_and_stays_dense(self, rng):
+        import warnings as _w
+
+        from paddle_tpu import nn as _nn
+
+        net = _nn.Linear(6, 8)
+        with _w.catch_warnings(record=True) as rec:
+            _w.simplefilter("always")
+            masks = asp.prune_model(net)
+        assert any("not divisible" in str(x.message) for x in rec)
+        assert not masks
+        assert asp.calculate_density(net.weight) == 1.0

@@ -417,3 +417,122 @@ class TestLogicDedup:
         np.testing.assert_array_equal(n(out), [1, 2, 3, 1])
         np.testing.assert_array_equal(n(cnt), [2, 3, 1, 2])
         np.testing.assert_array_equal(n(out)[n(inv)], x)
+
+
+class TestLongtailBatch2:
+    def test_stacks(self, rng):
+        a = rng.standard_normal((2, 3)).astype(np.float32)
+        b = rng.standard_normal((2, 3)).astype(np.float32)
+        for pn, nn_ in (("hstack", np.hstack), ("vstack", np.vstack),
+                        ("dstack", np.dstack),
+                        ("column_stack", np.column_stack),
+                        ("row_stack", np.vstack)):
+            np.testing.assert_allclose(
+                n(getattr(paddle, pn)([t(a), t(b)])), nn_([a, b]),
+                err_msg=pn)
+        v = rng.standard_normal(5).astype(np.float32)
+        assert n(paddle.atleast_2d(t(v))).shape == (1, 5)
+        assert n(paddle.atleast_3d(t(v))).shape == (1, 5, 1)
+
+    def test_layout_utils(self, rng):
+        x = rng.standard_normal((2, 12)).astype(np.float32)
+        out = paddle.unflatten(t(x), 1, [3, 4])
+        assert n(out).shape == (2, 3, 4)
+        a, b = paddle.broadcast_tensors([t(x[:, :1]), t(x)])
+        assert n(a).shape == n(b).shape == (2, 12)
+        m1 = rng.standard_normal((2, 2)).astype(np.float32)
+        m2 = rng.standard_normal((3, 3)).astype(np.float32)
+        import scipy.linalg as sl
+
+        np.testing.assert_allclose(n(paddle.block_diag([t(m1), t(m2)])),
+                                   sl.block_diag(m1, m2))
+        np.testing.assert_allclose(
+            n(paddle.crop(t(x), shape=[1, 4], offsets=[1, 2])),
+            x[1:2, 2:6])
+
+    def test_search_and_membership(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        x[1, 2] = np.nan
+        np.testing.assert_array_equal(n(paddle.nanargmax(t(x), axis=1)),
+                                      np.nanargmax(x, axis=1))
+        np.testing.assert_array_equal(n(paddle.nanargmin(t(x), axis=1)),
+                                      np.nanargmin(x, axis=1))
+        np.testing.assert_array_equal(
+            n(paddle.argwhere(t((x > 0).astype(np.float32)))),
+            np.argwhere(x > 0))
+        a = np.asarray([1, 2, 3, 4], np.int32)
+        tst = np.asarray([2, 4], np.int32)
+        np.testing.assert_array_equal(n(paddle.isin(t(a), t(tst))),
+                                      np.isin(a, tst))
+        bins = np.asarray([0.0, 1.0, 2.0], np.float32)
+        vals = np.asarray([-0.5, 0.5, 1.5, 2.5], np.float32)
+        np.testing.assert_array_equal(n(paddle.digitize(t(vals), t(bins))),
+                                      np.digitize(vals, bins))
+
+    def test_statistics(self, rng):
+        x = rng.standard_normal((3, 50)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.corrcoef(t(x))), np.corrcoef(x),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(n(paddle.cov(t(x))), np.cov(x),
+                                   rtol=1e-4, atol=1e-5)
+        a = rng.standard_normal((4, 3)).astype(np.float32)
+        b = rng.standard_normal((5, 3)).astype(np.float32)
+        import scipy.spatial.distance as ssd
+
+        np.testing.assert_allclose(n(paddle.cdist(t(a), t(b))),
+                                   ssd.cdist(a, b), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(n(paddle.pdist(t(a))), ssd.pdist(a),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_combinatorics(self, rng):
+        a = np.asarray([1.0, 2.0], np.float32)
+        b = np.asarray([3.0, 4.0, 5.0], np.float32)
+        got = n(paddle.cartesian_prod([t(a), t(b)]))
+        assert got.shape == (6, 2)
+        np.testing.assert_allclose(got[0], [1.0, 3.0])
+        np.testing.assert_allclose(got[-1], [2.0, 5.0])
+        v = np.asarray([1.0, 2.0, 3.0], np.float32)
+        comb = n(paddle.combinations(t(v), 2))
+        np.testing.assert_allclose(comb,
+                                   [[1, 2], [1, 3], [2, 3]])
+
+    def test_index_fill_increment_pad(self, rng):
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        out = paddle.index_fill(t(x), t(np.asarray([0, 2], np.int32)), 0, 9.0)
+        ref = x.copy(); ref[[0, 2]] = 9.0
+        np.testing.assert_allclose(n(out), ref)
+        y = t(np.zeros((2,), np.float32))
+        paddle.increment(y, 2.5)
+        np.testing.assert_allclose(n(y), 2.5)
+
+    def test_sampling(self, rng):
+        paddle.seed(0)
+        probs = np.asarray([[0.0, 0.0, 1.0], [1.0, 0.0, 0.0]], np.float32)
+        s = n(paddle.multinomial(t(probs), 4, replacement=True))
+        np.testing.assert_array_equal(s[0], 2)
+        np.testing.assert_array_equal(s[1], 0)
+        # without replacement: 3 draws from 3 categories = a permutation
+        s2 = n(paddle.multinomial(
+            t(np.full((3,), 1 / 3, np.float32)), 3, replacement=False))
+        assert sorted(s2.tolist()) == [0, 1, 2]
+        bern = n(paddle.bernoulli(t(np.full((1000,), 0.8, np.float32))))
+        assert 0.7 < bern.mean() < 0.9
+        poi = n(paddle.poisson(t(np.full((1000,), 4.0, np.float32))))
+        assert 3.0 < poi.mean() < 5.0
+        sn = n(paddle.standard_normal((2000,)))
+        assert abs(sn.mean()) < 0.15 and 0.8 < sn.std() < 1.2
+
+    def test_stack_ops_keep_gradients(self, rng):
+        """Review fix: stacked inputs stay on the autograd tape."""
+        x = t(rng.standard_normal((2, 3)).astype(np.float32))
+        x.stop_gradient = False
+        loss = (paddle.vstack([x, x * 2]) ** 2).sum()
+        loss.backward()
+        assert x.grad is not None
+        ref = 2 * n(x) + 2 * (2 * n(x)) * 2
+        np.testing.assert_allclose(n(x.grad), ref, rtol=1e-5)
+
+    def test_crop_out_of_bounds_raises(self, rng):
+        with pytest.raises(ValueError, match="out of bounds"):
+            paddle.crop(t(np.arange(10.0, dtype=np.float32)),
+                        shape=[3], offsets=[8])

@@ -330,6 +330,18 @@ class Tensor:
     def numpy(self):
         return np.asarray(jax.device_get(self._data))
 
+    def __array__(self, dtype=None, copy=None):
+        # np.asarray(tensor) must yield the values (reference paddle.Tensor
+        # supports the numpy protocol); without this numpy falls back to
+        # __iter__ and builds object arrays of scalar Tensors
+        if copy is False:
+            # numpy>=2 contract: copy=False must fail when a zero-copy view
+            # is impossible — device arrays always cross to host by copy
+            raise ValueError(
+                "cannot convert a paddle_tpu Tensor to numpy without a copy")
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
     def item(self):
         return self.numpy().item()
 

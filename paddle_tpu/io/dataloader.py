@@ -4,15 +4,28 @@ Host pipeline: sample indices → worker pool assembles numpy batches →
 bounded prefetch queue → ``jax.device_put`` double-buffering.
 
 Workers are **spawned processes** by default (the reference's
-worker-process design: dataloader_iter.py _DataLoaderIterMultiProcess),
-sending length-prefixed pickled batch frames over OS pipes (socketpair
-transport) that per-worker puller threads drain into the bounded prefetch
-queue. ``spawn`` (never fork — fork is hostile to a live PJRT client) and
-children are pinned to the CPU backend so they can't claim the TPU chip.
-Thread workers remain as the automatic fallback when the dataset/collate_fn
-can't pickle (and via ``worker_type="thread"``): their numpy/PIL work
-releases the GIL, but pure-Python transforms serialize — the process pool
-is what scales those (round-1 verdict #8).
+worker-process design: dataloader_iter.py _DataLoaderIterMultiProcess) with
+dynamic task dispatch over duplex pipes: the parent streams
+``(batch_index, sample_indices)`` tasks and each worker returns batches as
+they finish, so a slow batch doesn't stall a statically-assigned shard.
+Batch payloads travel one of two ways:
+
+* ``use_shared_memory=True`` (default, reference parity): array leaves are
+  written into a ``multiprocessing.shared_memory`` segment and only the
+  (name, shapes, dtypes, offsets) metadata rides the pipe; the parent copies
+  out and acks so the worker can unlink. This is the reference's shared-mem
+  queue design (``use_shared_memory`` in dataloader_iter.py) — large batches
+  skip pickle framing and the 64 KiB socketpair chunking entirely.
+* otherwise pickled frames over the OS pipe.
+
+``persistent_workers=True`` keeps the pool alive across epochs (dataset is
+shipped to each worker once at spawn, not re-pickled per epoch). ``spawn``
+(never fork — fork is hostile to a live PJRT client) and children are pinned
+to the CPU backend so they can't claim the TPU chip. Thread workers remain
+as the automatic fallback when the dataset/collate_fn can't pickle (and via
+``worker_type="thread"``): their numpy/PIL work releases the GIL, but
+pure-Python transforms serialize — the process pool is what scales those
+(round-1 verdict #8).
 """
 from __future__ import annotations
 
@@ -29,26 +42,349 @@ import numpy as np
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
+# below this many payload bytes the pipe wins (shm create/attach has fixed
+# syscall cost); above it the shared segment skips pickle + pipe chunking
+_SHM_MIN_BYTES = 1 << 16
 
-def _process_worker(conn, dataset, collate_fn, worker_init_fn, wid,
-                    assigned):
-    """Child entry: compute assigned (global_index, sample_indices) batches
-    in order, ship length-prefixed pickle frames over the pipe."""
+
+class _NullSink:
+    """Write-discarding file object for the picklability probe: streams the
+    pickle instead of materializing the whole serialized dataset in memory
+    (advisor r2: probing with pickle.dumps spiked memory for big in-memory
+    datasets)."""
+
+    def write(self, b):
+        return len(b)
+
+
+def _probe_picklable(*objs) -> bool:
+    try:
+        pickle.dump(objs, _NullSink(), protocol=pickle.HIGHEST_PROTOCOL)
+        return True
+    except Exception:
+        return False
+
+
+# ------------------------------------------------------- batch tree helpers
+
+
+def _tree_flatten(obj):
+    """Split a collated batch into (array_leaves, structure). Local —
+    workers must not import jax just for tree_util."""
+    arrs = []
+
+    def rec(o):
+        if isinstance(o, np.ndarray):
+            arrs.append(o)
+            return ("a", len(arrs) - 1)
+        if isinstance(o, tuple):
+            return ("t", [rec(x) for x in o])
+        if isinstance(o, list):
+            return ("l", [rec(x) for x in o])
+        if isinstance(o, dict):
+            return ("d", {k: rec(v) for k, v in o.items()})
+        return ("v", o)
+
+    return arrs, rec(obj)
+
+
+def _tree_unflatten(tree, arrs):
+    tag, val = tree
+    if tag == "a":
+        return arrs[val]
+    if tag == "t":
+        return tuple(_tree_unflatten(x, arrs) for x in val)
+    if tag == "l":
+        return [_tree_unflatten(x, arrs) for x in val]
+    if tag == "d":
+        return {k: _tree_unflatten(v, arrs) for k, v in val.items()}
+    return val
+
+
+# ----------------------------------------------------------- worker process
+
+
+def _process_worker(conn, dataset, collate_fn, worker_init_fn, wid, use_shm):
+    """Child entry: serve ("task", i, idxs) requests until ("stop",).
+
+    Results go back as ("data", i, batch) pickle frames, or — when shm is on
+    and the batch is big enough — as ("shm", i, name, metas, tree) with the
+    arrays in a shared segment the worker unlinks on the parent's ack."""
+    from multiprocessing import shared_memory
+
+    pending = {}
     try:
         if worker_init_fn is not None:
             worker_init_fn(wid)
-        for i, idxs in assigned:
-            data = collate_fn([dataset[j] for j in idxs])
-            conn.send_bytes(
-                pickle.dumps((i, data), protocol=pickle.HIGHEST_PROTOCOL))
-        conn.send_bytes(pickle.dumps((None, None)))
-    except Exception as e:  # surfaced in the consumer
+        while True:
+            msg = conn.recv()
+            kind = msg[0]
+            if kind == "stop":
+                break
+            if kind == "ack":
+                shm = pending.pop(msg[1], None)
+                if shm is not None:
+                    shm.close()
+                    shm.unlink()
+                continue
+            _, epoch, i, idxs = msg
+            try:
+                data = collate_fn([dataset[j] for j in idxs])
+                sent = False
+                if use_shm:
+                    arrs, tree = _tree_flatten(data)
+                    nbytes = sum(a.nbytes for a in arrs)
+                    if arrs and nbytes >= _SHM_MIN_BYTES:
+                        shm = shared_memory.SharedMemory(
+                            create=True, size=nbytes)
+                        metas, off = [], 0
+                        for a in arrs:
+                            a = np.ascontiguousarray(a)
+                            np.ndarray(a.shape, a.dtype, buffer=shm.buf,
+                                       offset=off)[...] = a
+                            metas.append((a.shape, a.dtype.str, off))
+                            off += a.nbytes
+                        pending[shm.name] = shm
+                        conn.send(("shm", epoch, i, shm.name, metas, tree))
+                        sent = True
+                if not sent:
+                    conn.send(("data", epoch, i, data))
+            except Exception as e:  # surfaced in the consumer
+                try:
+                    conn.send(("err", epoch, i, e))
+                except Exception:
+                    # unpicklable exception: ship a picklable stand-in
+                    # rather than dying with the task marked in-flight
+                    conn.send(("err", epoch, i,
+                               RuntimeError(f"worker {wid} batch {i}: "
+                                            f"{type(e).__name__}: {e}")))
+    except (EOFError, OSError):
+        pass  # parent went away — clean exit
+    finally:
+        for shm in pending.values():
+            try:
+                shm.close()
+                shm.unlink()
+            except Exception:
+                pass
         try:
-            conn.send_bytes(pickle.dumps((-1, e)))
+            conn.close()
         except Exception:
             pass
-    finally:
-        conn.close()
+
+
+class _ProcessPool:
+    """Spawned worker pool with dynamic dispatch and ordered delivery.
+
+    All pipe *sends* happen on the consumer thread (tasks, acks, stop); one
+    puller thread per worker does the *recvs* — duplex Connections allow
+    concurrent send/recv, they just can't share a direction across threads.
+    """
+
+    def __init__(self, dataset, collate_fn, worker_init_fn, num_workers,
+                 use_shm):
+        ctx = multiprocessing.get_context("spawn")
+        # children must never claim the TPU chip or init a TPU backend;
+        # env is captured at spawn time, so pin and restore around start()
+        saved = {k: os.environ.get(k)
+                 for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        self.procs, self.conns = [], []
+        self.use_shm = use_shm
+        self.closed = False
+        try:
+            for w in range(num_workers):
+                parent_conn, child_conn = ctx.Pipe(duplex=True)
+                p = ctx.Process(
+                    target=_process_worker,
+                    args=(child_conn, dataset, collate_fn, worker_init_fn,
+                          w, use_shm),
+                    daemon=True)
+                p.start()
+                child_conn.close()
+                self.procs.append(p)
+                self.conns.append(parent_conn)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        # ONE puller per worker for the pool's lifetime (a persistent pool
+        # must not stack a second recv-er on the same Connection next epoch)
+        self.out_q: "queue.Queue" = queue.Queue()
+        self._DEAD = DEAD = object()
+        self._dead = set()
+        self._epoch = 0  # results are epoch-tagged: an abandoned epoch's
+        # in-flight results must not be mistaken for the next epoch's
+
+        def pull(wid, conn, out_q=self.out_q):
+            try:
+                while True:
+                    out_q.put((wid, conn.recv()))
+            except (EOFError, OSError):
+                out_q.put((wid, DEAD))
+
+        self._pullers = [
+            threading.Thread(target=pull, args=(w, c), daemon=True)
+            for w, c in enumerate(self.conns)
+        ]
+        for t in self._pullers:
+            t.start()
+
+    def _send(self, wid, msg) -> bool:
+        """Send to a worker; a broken pipe marks it dead instead of raising
+        into the training loop (its DEAD sentinel may still be in flight)."""
+        if wid in self._dead:
+            return False
+        try:
+            self.conns[wid].send(msg)
+            return True
+        except (OSError, ValueError):
+            self._dead.add(wid)
+            return False
+
+    def run_epoch(self, batches, prefetch_per_worker, timeout=0):
+        """Yield collated batches for ``batches`` (list of index lists) in
+        order. Tasks are dispatched ``prefetch_per_worker`` deep per worker;
+        a worker gets its next task the moment a result lands, and a dead
+        worker's in-flight tasks are redispatched to the survivors."""
+        from collections import deque
+        from multiprocessing import shared_memory
+
+        n = len(batches)
+        W = len(self.conns)
+        out_q = self.out_q
+        DEAD = self._DEAD
+        self._epoch += 1
+        epoch = self._epoch
+        next_task = 0
+        redo: "deque" = deque()  # batch indices orphaned by a dead worker
+        inflight = {w: set() for w in range(W)}
+
+        def feed(wid):
+            nonlocal next_task
+            while True:
+                if redo:
+                    i = redo.popleft()
+                elif next_task < n:
+                    i = next_task
+                    next_task += 1
+                else:
+                    return False
+                if self._send(wid, ("task", epoch, i, batches[i])):
+                    inflight[wid].add(i)
+                    return True
+                # send failed: worker just died — requeue and give up on it
+                redo.appendleft(i)
+                reap(wid)
+                return False
+
+        def reap(wid):
+            """Mark dead + orphan its in-flight tasks for redispatch."""
+            self._dead.add(wid)
+            redo.extend(sorted(inflight.pop(wid, ())))
+
+        # prime each live worker prefetch-deep
+        for w in range(W):
+            if w in self._dead:
+                continue
+            for _ in range(prefetch_per_worker):
+                if not feed(w):
+                    break
+
+        results, want = {}, 0
+        while want < n:
+            while want not in results:
+                if len(self._dead) == W and out_q.empty():
+                    # every worker is gone (their pullers have exited, so
+                    # the queue is final) — the wanted batch can't arrive
+                    raise RuntimeError(
+                        "DataLoader worker processes exited before "
+                        "delivering all batches")
+                # orphaned work + live workers with a free slot → redispatch
+                while redo:
+                    target = next(
+                        (w for w in range(W) if w not in self._dead
+                         and len(inflight[w]) < prefetch_per_worker), None)
+                    if target is None or not feed(target):
+                        break
+                try:
+                    wid, msg = out_q.get(
+                        timeout=timeout if timeout > 0 else None)
+                except queue.Empty:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {timeout}s waiting "
+                        "for a worker batch")
+                if msg is DEAD:
+                    if wid not in self._dead or inflight.get(wid):
+                        reap(wid)
+                    continue
+                kind = msg[0]
+                if kind == "shm":
+                    _, ep, i, name, metas, tree = msg
+                    if ep != epoch:
+                        # stale result from an abandoned epoch: ack so the
+                        # worker unlinks the segment, drop the payload
+                        self._send(wid, ("ack", name))
+                        continue
+                    # NOTE: attach re-registers the name with the (shared,
+                    # spawn-inherited) resource_tracker, whose cache is a
+                    # set — the worker's unlink after our ack is the single
+                    # balancing unregister; do NOT unregister here too
+                    seg = shared_memory.SharedMemory(name=name)
+                    try:
+                        arrs = [
+                            np.array(np.ndarray(
+                                shape, np.dtype(dt), buffer=seg.buf,
+                                offset=off))
+                            for shape, dt, off in metas
+                        ]
+                    finally:
+                        seg.close()
+                    self._send(wid, ("ack", name))
+                    results[i] = _tree_unflatten(tree, arrs)
+                else:
+                    _, ep, i, payload = msg
+                    if ep != epoch:
+                        continue
+                    if kind == "err":
+                        raise payload
+                    results[i] = payload
+                inflight.get(wid, set()).discard(i)
+                feed(wid)
+            yield results.pop(want)
+            want += 1
+
+    def alive(self) -> bool:
+        return (not self.closed and not self._dead
+                and all(p.is_alive() for p in self.procs))
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        for c in self.conns:
+            try:
+                c.send(("stop",))
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for c in self.conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def default_collate_fn(batch):
@@ -87,6 +423,9 @@ class DataLoader:
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.worker_init_fn = worker_init_fn
         self.to_device = to_device
+        self.use_shared_memory = bool(use_shared_memory)
+        self.persistent_workers = bool(persistent_workers)
+        self.timeout = timeout
         if worker_type not in (None, "process", "thread"):
             raise ValueError(f"worker_type must be 'process'/'thread', got "
                              f"{worker_type!r}")
@@ -94,6 +433,7 @@ class DataLoader:
         # when the dataset/collate_fn can't pickle
         self.worker_type = worker_type
         self._picklable: Optional[bool] = None
+        self._pool: Optional[_ProcessPool] = None
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -133,19 +473,15 @@ class DataLoader:
 
         mode = self.worker_type
         if mode in (None, "process"):
-            if self._picklable is None:  # probe once, not per epoch — the
-                # dump serializes the whole dataset just to be thrown away
-                try:
-                    pickle.dumps((self.dataset, self.collate_fn,
-                                  self.worker_init_fn))
-                    self._picklable = True
-                except Exception:
-                    self._picklable = False
-                    if mode != "process":
-                        warnings.warn(
-                            "DataLoader: dataset/collate_fn not picklable — "
-                            "falling back to thread workers", RuntimeWarning,
-                            stacklevel=2)
+            if self._picklable is None:  # probe once, streamed to a null
+                # sink — no full serialized copy is held (advisor r2)
+                self._picklable = _probe_picklable(
+                    self.dataset, self.collate_fn, self.worker_init_fn)
+                if not self._picklable and mode != "process":
+                    warnings.warn(
+                        "DataLoader: dataset/collate_fn not picklable — "
+                        "falling back to thread workers", RuntimeWarning,
+                        stacklevel=2)
             if not self._picklable and mode == "process":
                 pickle.dumps((self.dataset, self.collate_fn,
                               self.worker_init_fn))  # re-raise the error
@@ -187,7 +523,14 @@ class DataLoader:
             want = 0
             while want < n:
                 while want not in results:
-                    i, data = out_q.get()
+                    try:
+                        i, data = out_q.get(
+                            timeout=self.timeout if self.timeout > 0
+                            else None)
+                    except queue.Empty:
+                        raise RuntimeError(
+                            f"DataLoader timed out after {self.timeout}s "
+                            "waiting for a worker batch")
                     results[i] = data
                 data = results.pop(want)
                 if isinstance(data, Exception):
@@ -198,88 +541,39 @@ class DataLoader:
             stop.set()
 
     def _batches_process(self, batches):
-        """Spawned worker processes, round-robin batch assignment, ordered
-        delivery. Frames ride OS pipes; per-worker puller threads (pipe reads
-        release the GIL) feed a bounded queue sized num_workers ×
-        prefetch_factor for lookahead."""
-        n = len(batches)
-        W = min(self.num_workers, max(n, 1))
-        ctx = multiprocessing.get_context("spawn")
-        # children must never claim the TPU chip or init a TPU backend;
-        # env is captured at spawn time, so pin and restore around start()
-        saved = {k: os.environ.get(k)
-                 for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ["PALLAS_AXON_POOL_IPS"] = ""
-        procs, conns = [], []
+        """Process-pool epoch: dynamic dispatch + ordered delivery; the pool
+        outlives the epoch when ``persistent_workers`` (dataset shipped once
+        at spawn). Pool size is always num_workers — a short epoch (e.g. a
+        small validation pass) leaves surplus workers idle rather than
+        respawning the pool at the next full epoch."""
+        W = self.num_workers
+        pool = self._pool
+        if pool is not None and (not pool.alive() or len(pool.conns) != W):
+            pool.close()
+            pool = None
+        if pool is None:
+            pool = _ProcessPool(self.dataset, self.collate_fn,
+                                self.worker_init_fn, W,
+                                self.use_shared_memory)
+        self._pool = pool if self.persistent_workers else None
         try:
-            for w in range(W):
-                rd, wr = ctx.Pipe(duplex=False)
-                assigned = list(enumerate(batches))[w::W]
-                p = ctx.Process(
-                    target=_process_worker,
-                    args=(wr, self.dataset, self.collate_fn,
-                          self.worker_init_fn, w, assigned),
-                    daemon=True)
-                p.start()
-                wr.close()  # parent keeps only the read end
-                procs.append(p)
-                conns.append(rd)
+            yield from pool.run_epoch(batches, self.prefetch_factor,
+                                      self.timeout)
         finally:
-            for k, v in saved.items():
-                if v is None:
-                    os.environ.pop(k, None)
-                else:
-                    os.environ[k] = v
+            if not self.persistent_workers:
+                pool.close()
 
-        out_q: "queue.Queue" = queue.Queue(
-            maxsize=W * self.prefetch_factor)
-        DONE = object()
+    def close(self):
+        """Tear down a persistent worker pool (no-op otherwise)."""
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
 
-        def pull(conn):
-            try:
-                while True:
-                    i, data = pickle.loads(conn.recv_bytes())
-                    if i is None:
-                        return
-                    out_q.put((i, data))
-            except (EOFError, OSError):
-                # EOF: worker exited (normal after its DONE frame, or died —
-                # the liveness check below reports short delivery). OSError:
-                # consumer finished early and closed our read end mid-recv.
-                pass
-            finally:
-                out_q.put((None, DONE))
-
-        pullers = [threading.Thread(target=pull, args=(c,), daemon=True)
-                   for c in conns]
-        for t in pullers:
-            t.start()
+    def __del__(self):
         try:
-            results, want, live = {}, 0, W
-            while want < n:
-                while want not in results:
-                    if live == 0 and out_q.empty():
-                        raise RuntimeError(
-                            "DataLoader worker processes exited before "
-                            "delivering all batches")
-                    i, data = out_q.get()
-                    if data is DONE:
-                        live -= 1
-                        continue
-                    if i == -1:
-                        raise data  # exception forwarded from a worker
-                    results[i] = data
-                data = results.pop(want)
-                yield data
-                want += 1
-        finally:
-            for c in conns:
-                c.close()
-            for p in procs:
-                p.join(timeout=5)
-                if p.is_alive():
-                    p.terminate()
+            self.close()
+        except Exception:
+            pass
 
     def __iter__(self):
         from ..framework.tensor import Tensor

@@ -66,9 +66,13 @@ def _b(fn, x, y):
 
 
 def masked_fill(x, mask, value):
-    m = _t(mask)
-    v = value._data if isinstance(value, Tensor) else value
-    return apply_op(lambda a, mm: jnp.where(mm, v, a), _t(x), m)
+    if isinstance(value, Tensor):
+        # value must enter through apply_op so the tape records its VJP —
+        # the reference op differentiates w.r.t. a Tensor fill value
+        return apply_op(
+            lambda a, mm, vv: jnp.where(mm, vv.astype(a.dtype), a),
+            _t(x), _t(mask), value)
+    return apply_op(lambda a, mm: jnp.where(mm, value, a), _t(x), _t(mask))
 
 
 def masked_scatter(x, mask, value):
@@ -175,12 +179,23 @@ def _cum_extreme(x, axis, is_max):
     return vals, idxs
 
 
+def _cast_index(t, dtype):
+    """Honor the reference's index-dtype parameter ('int32'/'int64'); with
+    x64 disabled int64 lowers to int32 (see _index_dtype)."""
+    from ..framework.dtype import convert_dtype
+
+    dt = convert_dtype(dtype) if isinstance(dtype, str) else dtype
+    return Tensor(t._data.astype(dt))
+
+
 def cummax(x, axis=None, dtype="int64"):
-    return _cum_extreme(x, axis, True)
+    vals, idxs = _cum_extreme(x, axis, True)
+    return vals, _cast_index(idxs, dtype)
 
 
 def cummin(x, axis=None, dtype="int64"):
-    return _cum_extreme(x, axis, False)
+    vals, idxs = _cum_extreme(x, axis, False)
+    return vals, _cast_index(idxs, dtype)
 
 
 def logcumsumexp(x, axis=None):
@@ -192,9 +207,19 @@ def logcumsumexp(x, axis=None):
     return _u(fn, x)
 
 
+def _index_dtype(out_int32):
+    """Index dtype policy: the reference returns int64 unless out_int32. With
+    jax x64 disabled (this framework's default), jnp.int64 silently lowers to
+    int32 — make that explicit here so searchsorted/bucketize/multinomial all
+    share one documented behavior instead of a per-op silent cast."""
+    if out_int32:
+        return jnp.int32
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 def searchsorted(sorted_sequence, values, out_int32=False, right=False):
     side = "right" if right else "left"
-    dt = jnp.int32 if out_int32 else jnp.int64
+    dt = _index_dtype(out_int32)
 
     def fn(seq, v):
         if seq.ndim == 1:
@@ -212,7 +237,7 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False):
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False):
     side = "right" if right else "left"
-    dt = jnp.int32 if out_int32 else jnp.int64
+    dt = _index_dtype(out_int32)
     return apply_op(
         lambda a, s: jnp.searchsorted(s, a, side=side).astype(dt),
         _t(x), _t(sorted_sequence))
@@ -254,11 +279,65 @@ def mode(x, axis=-1, keepdim=False):
     return v, i
 
 
+def _median_min(x, axis, keepdim, nan_aware):
+    """mode='min' median: the lower of the two middle elements, plus its
+    index along ``axis`` (reference returns (values, indices) when axis is
+    given). NaNs sort last, which matches reference nanmedian masking for
+    the lower-middle pick as long as NaN count < valid count per slice."""
+
+    ndim_in = _t(x)._data.ndim
+
+    def pick_idx(a):
+        if axis is None:  # reference flattens when no axis is given
+            a, ax = a.reshape(-1), 0
+        else:
+            ax = axis % a.ndim
+        n = a.shape[ax]
+        if nan_aware:
+            valid = jnp.sum(~jnp.isnan(jnp.moveaxis(a, ax, -1)), axis=-1)
+            k = jnp.maximum((valid - 1) // 2, 0)
+        else:
+            k = (n - 1) // 2
+        order = jnp.argsort(jnp.moveaxis(a, ax, -1), axis=-1)
+        kk = jnp.broadcast_to(jnp.asarray(k), order.shape[:-1])[..., None]
+        idx = jnp.take_along_axis(order, kk, axis=-1)[..., 0]
+        return jnp.expand_dims(idx, ax) if keepdim else idx, ax
+
+    # one argsort pass: indices (non-differentiable) computed raw, then the
+    # value is a take_along_axis through the tape so grads flow to x
+    idx_raw, ax = pick_idx(_t(x)._data)
+    idx_g = idx_raw if keepdim else jnp.expand_dims(idx_raw, ax)
+
+    def gather(a):
+        if axis is None:
+            a = a.reshape(-1)
+        val = jnp.take_along_axis(a, idx_g.astype(jnp.int32), axis=ax)
+        if not keepdim:
+            return jnp.squeeze(val, ax)
+        if axis is None:
+            # numpy keepdims semantics for a full reduction: rank preserved
+            return val.reshape((1,) * ndim_in)
+        return val
+
+    return _u(gather, x), Tensor(idx_raw)
+
+
 def median(x, axis=None, keepdim=False, mode="avg"):
+    if mode == "min":
+        vals, idxs = _median_min(x, axis, keepdim, nan_aware=False)
+        # reference: index only meaningful (and returned) with an axis
+        return vals if axis is None else (vals, idxs)
+    if mode != "avg":
+        raise ValueError(f"median mode must be 'avg' or 'min', got {mode!r}")
     return _u(lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
 
 
 def nanmedian(x, axis=None, keepdim=False, mode="avg"):
+    if mode == "min":
+        vals, idxs = _median_min(x, axis, keepdim, nan_aware=True)
+        return vals if axis is None else (vals, idxs)
+    if mode != "avg":
+        raise ValueError(f"nanmedian mode must be 'avg' or 'min', got {mode!r}")
     return _u(lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
 
 
@@ -848,6 +927,15 @@ def combinations(x, r=2, with_replacement=False):
 
 
 def index_fill(x, index, axis, value):
+    if isinstance(value, Tensor):
+        # Tensor fill value flows through apply_op so gradients reach it
+        def fnv(a, i, vv):
+            am = jnp.moveaxis(a, axis, 0)
+            vb = jnp.broadcast_to(vv.astype(a.dtype), am[i].shape)
+            return jnp.moveaxis(am.at[i].set(vb), 0, axis)
+
+        return apply_op(fnv, _t(x), _t(index), value)
+
     def fn(a, i):
         am = jnp.moveaxis(a, axis, 0)
         return jnp.moveaxis(am.at[i].set(value), 0, axis)
@@ -894,7 +982,7 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
         # Gumbel top-k = sampling without replacement
         g = jax.random.gumbel(key, probs.shape)
         _, out = jax.lax.top_k(logits + g, num_samples)
-    return Tensor(out.astype(jnp.int64))
+    return Tensor(out.astype(_index_dtype(False)))
 
 
 def bernoulli(x, name=None):

@@ -536,3 +536,64 @@ class TestLongtailBatch2:
         with pytest.raises(ValueError, match="out of bounds"):
             paddle.crop(t(np.arange(10.0, dtype=np.float32)),
                         shape=[3], offsets=[8])
+
+
+class TestAdviceR2Fixes:
+    """Advisor round-2 findings: parameter honesty + Tensor-value grads."""
+
+    def test_masked_fill_tensor_value_grad(self, rng):
+        x = t(rng.standard_normal((3, 4)).astype(np.float32))
+        v = t(np.asarray(2.5, np.float32))
+        x.stop_gradient = False
+        v.stop_gradient = False
+        m = t(np.asarray([[True, False, True, False]] * 3))
+        out = paddle.masked_fill(x, m, v)
+        out.sum().backward()
+        # grad w.r.t. value = number of filled positions
+        np.testing.assert_allclose(n(v.grad), 6.0)
+        np.testing.assert_allclose(n(x.grad), np.where(n(m), 0.0, 1.0))
+
+    def test_index_fill_tensor_value_grad(self, rng):
+        x = t(rng.standard_normal((3, 4)).astype(np.float32))
+        v = t(np.asarray(1.5, np.float32))
+        x.stop_gradient = False
+        v.stop_gradient = False
+        out = paddle.index_fill(x, t(np.asarray([0, 2], np.int32)), 0, v)
+        out.sum().backward()
+        np.testing.assert_allclose(n(v.grad), 8.0)  # 2 rows x 4 cols
+
+    def test_cummax_dtype_honored(self, rng):
+        x = t(rng.standard_normal((3, 4)).astype(np.float32))
+        _, i32 = paddle.cummax(x, axis=1, dtype="int32")
+        assert n(i32).dtype == np.int32
+        _, imin = paddle.cummin(x, axis=1, dtype="int32")
+        assert n(imin).dtype == np.int32
+
+    def test_median_min_mode(self, rng):
+        x = np.asarray([[5.0, 1.0, 3.0, 2.0], [4.0, 4.0, 0.0, 6.0]],
+                       np.float32)
+        vals, idxs = paddle.median(t(x), axis=1, mode="min")
+        # lower middle of sorted row: [1,2,3,5]->2 (idx 3), [0,4,4,6]->4
+        np.testing.assert_allclose(n(vals), [2.0, 4.0])
+        assert n(idxs)[0] == 3
+        assert x[1, n(idxs)[1]] == 4.0
+        # axis=None returns only the value
+        v = paddle.median(t(x), mode="min")
+        np.testing.assert_allclose(n(v), 3.0)
+        with pytest.raises(ValueError, match="mode"):
+            paddle.median(t(x), mode="max")
+
+    def test_nanmedian_min_mode(self):
+        x = np.asarray([[np.nan, 1.0, 3.0, 2.0]], np.float32)
+        vals, idxs = paddle.nanmedian(t(x), axis=1, mode="min")
+        np.testing.assert_allclose(n(vals), [2.0])
+        assert x[0, n(idxs)[0]] == 2.0
+
+    def test_searchsorted_index_dtype_policy(self):
+        seq = t(np.asarray([1.0, 3.0, 5.0], np.float32))
+        out = paddle.searchsorted(seq, t(np.asarray([2.0], np.float32)))
+        # x64 disabled -> documented int32 result (not a silent cast)
+        assert n(out).dtype == np.int32
+        out32 = paddle.searchsorted(
+            seq, t(np.asarray([2.0], np.float32)), out_int32=True)
+        assert n(out32).dtype == np.int32

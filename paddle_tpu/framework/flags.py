@@ -51,6 +51,9 @@ def get_flags(names):
 # Core flags (names mirror the reference where a concept carries over).
 define_flag("FLAGS_allocator_strategy", "xla_bfc", "allocator is XLA/PJRT's BFC; informational")
 define_flag("FLAGS_use_flash_attention", True, "route attention through the Pallas flash kernel")
+define_flag("FLAGS_use_packed_attention", None,
+            "packed-QKV causal kernel on the train path: None = auto "
+            "(TPU only), True = force (interpret mode off-TPU), False = off")
 define_flag("FLAGS_flash_attn_block_q", 128, "flash attention q tile")
 define_flag("FLAGS_flash_attn_block_k", 128, "flash attention kv tile")
 define_flag("FLAGS_check_nan_inf", False, "enable debug nan checks in optimizer steps")

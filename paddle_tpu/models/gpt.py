@@ -94,8 +94,42 @@ class GPTAttention(nn.Layer):
         self.qkv_proj = nn.Linear(h, 3 * h)
         self.out_proj = nn.Linear(h, h)
 
+    def _packed_ok(self, s):
+        """Train-path packed kernel eligibility (see causal_flash.py)."""
+        from ..framework.flags import get_flags
+        from ..ops.pallas import causal_flash
+
+        flag = get_flags("FLAGS_use_packed_attention")[
+            "FLAGS_use_packed_attention"]
+        if flag is None:
+            flag = jax.default_backend() == "tpu"
+        return (bool(flag) and self.use_flash and self.attn_dropout == 0.0
+                and causal_flash.supported(s, self.head_dim))
+
+    def _forward_packed(self, x):
+        """Zero-glue train path: qkv projection emitted as [b, 3H, s, D] and
+        the output projection consumed as [b, H, s, D] — beside the packed
+        kernel, every layout change lives inside an einsum where XLA folds
+        it into the GEMM (no transpose/unbind materialization)."""
+        from ..ops.pallas.causal_flash import causal_flash_qkv
+
+        nh, hd = self.num_heads, self.head_dim
+
+        def fn(xa, wq, bq, wo, bo):
+            w3 = wq.reshape(xa.shape[-1], 3 * nh, hd).astype(xa.dtype)
+            b3 = bq.reshape(3 * nh, 1, hd).astype(xa.dtype)
+            qkv = jnp.einsum("bsi,iod->bosd", xa, w3) + b3
+            o = causal_flash_qkv(qkv, nh)
+            wo3 = wo.reshape(nh, hd, wo.shape[-1]).astype(xa.dtype)
+            return jnp.einsum("bhsd,hdo->bso", o, wo3) + bo.astype(xa.dtype)
+
+        return apply_op(fn, x, self.qkv_proj.weight, self.qkv_proj.bias,
+                        self.out_proj.weight, self.out_proj.bias)
+
     def forward(self, x, cache=None, time_step=None):
         b, s, h = x.shape
+        if cache is None and self._packed_ok(s):
+            return self._forward_packed(x)
         qkv = self.qkv_proj(x)  # [b, s, 3h]
         qkv = qkv.reshape([b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv.unbind(axis=2)  # each [b, s, nh, hd]

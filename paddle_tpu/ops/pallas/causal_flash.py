@@ -1,0 +1,183 @@
+"""Packed-QKV causal flash attention, v2 train-path kernel.
+
+Reference capability: the fused attention inside
+paddle/fluid/operators/fused/fused_multi_transformer_op.cu and the external
+flash-attn library (paddle/phi/kernels/gpu/flash_attn_kernel.cu). This TPU
+design differs from ops/pallas/flash_attention.py (the general kernel) in
+two ways that dominate its speedup at train shapes:
+
+1. **Packed layout, zero glue.** Input is the QKV projection output viewed
+   as ``[B, 3H, S, D]`` and the output is ``[B, H, S, D]`` — both reachable
+   from the surrounding GEMMs by einsum alone, so XLA folds every layout
+   change into the matmuls and nothing materializes between GEMM and kernel
+   (the general kernel's [B,S,H,D]→[B*H,S,D] transposes + qkv unbind copies
+   cost ~0.4 ms/layer at GPT-medium scale). The same qkv array is passed
+   three times with different index maps — no slicing copies. The lse
+   residual is written as a [B, H, S, 1] column (the general kernel wrote a
+   128-lane broadcast, 64 MB of pure padding per layer).
+2. **One fused backward.** dQ, dK, dV come out of a single whole-sequence
+   program per (batch, head) that forms the logits once (the split
+   dkv/dq kernel pair forms them twice), computes delta = rowsum(dO·O)
+   in-kernel, runs every dot in the input dtype (bf16 on the train path)
+   with fp32 accumulation, and writes all three grads into one
+   ``[B, 3, H, S, D]`` array that bitcasts to the packed layout the QKV
+   projection's backward consumes.
+
+Whole-sequence single-step programs deliberately pay the full S×S square
+(no causal skip): measured on v5e, Mosaic's cross-grid-step pipelining
+beats both in-kernel fori chunk loops (~1.3x slower despite computing the
+triangle only) and finer grid blocks (~2x slower from per-step overhead) at
+S ≤ 1024.
+
+Constraints: D in {64, 128, 256}, S % 8 == 0, S <= _MAX_SEQ (whole-seq VMEM
+residency — the [S, S] fp32 logits chunk is the budget), causal only, no
+dropout inside the kernel (the model applies dropout outside).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1.0e30
+
+# [S, S] fp32 logits + exp + bf16 copy resident per program: 1024 -> ~12 MB
+_MAX_SEQ = 1024
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _causal_mask(s, sq, sk):
+    q_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    k_ids = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return jnp.where(q_ids >= k_ids, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------- fwd
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, seq):
+    q = q_ref[0, 0]  # [S, D]
+    k = k_ref[0, 0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = _causal_mask(s, seq, seq)
+    m = jnp.max(s, axis=-1, keepdims=True)  # causal row 0 always sees col 0
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    acc = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0, 0],
+                              (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0] = m + jnp.log(l)
+
+
+def _fwd(qkv, num_heads, scale):
+    b, three_h, seq, d = qkv.shape
+    h = num_heads
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, seq=seq),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + h, 0, 0)),
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + 2 * h, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, 1), lambda bi, hi: (bi, hi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, seq, d), qkv.dtype),
+            jax.ShapeDtypeStruct((b, h, seq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qkv, qkv, qkv)
+    return out, lse
+
+
+# ---------------------------------------------------------------------- bwd
+
+
+def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dqkv_ref, *,
+                scale, seq):
+    from .flash_attention import fused_bwd_math
+
+    dq, dk, dv = fused_bwd_math(
+        q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], o_ref[0, 0], do_ref[0, 0],
+        lse_ref[0, 0], scale=scale, causal=True, kv_valid=None)
+    dqkv_ref[0, 0, 0] = dq.astype(dqkv_ref.dtype)
+    dqkv_ref[0, 1, 0] = dk.astype(dqkv_ref.dtype)
+    dqkv_ref[0, 2, 0] = dv.astype(dqkv_ref.dtype)
+
+
+def _bwd(num_heads, scale, res, do):
+    qkv, out, lse = res
+    b, three_h, seq, d = qkv.shape
+    h = num_heads
+    dqkv5 = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale, seq=seq),
+        grid=(b, h),
+        in_specs=[
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + h, 0, 0)),
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + 2 * h, 0, 0)),
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, 1), lambda bi, hi: (bi, hi, 0, 0)),
+        ],
+        # one out array [B, 3, H, S, D]; the (1,3,1,S,D) block lets a single
+        # program write its head's dQ, dK, dV — reshaping to [B,3H,S,D] is a
+        # free bitcast for the caller
+        out_specs=pl.BlockSpec((1, 3, 1, seq, d),
+                               lambda bi, hi: (bi, 0, hi, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, 3, h, seq, d), qkv.dtype),
+        interpret=_interpret(),
+    )(qkv, qkv, qkv, out, do, lse)
+    return dqkv5.reshape(b, three_h, seq, d)
+
+
+# ------------------------------------------------------------------- public
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _packed(qkv, num_heads, scale):
+    out, _ = _fwd(qkv, num_heads, scale)
+    return out
+
+
+def _packed_fwd_rule(qkv, num_heads, scale):
+    out, lse = _fwd(qkv, num_heads, scale)
+    return out, (qkv, out, lse)
+
+
+def _packed_bwd_rule(num_heads, scale, res, do):
+    return (_bwd(num_heads, scale, res, do),)
+
+
+_packed.defvjp(_packed_fwd_rule, _packed_bwd_rule)
+
+
+def supported(seq: int, head_dim: int) -> bool:
+    return seq % 8 == 0 and seq <= _MAX_SEQ and head_dim in (64, 128, 256)
+
+
+def causal_flash_qkv(qkv, num_heads, scale=None):
+    """Causal self-attention on a packed QKV tensor.
+
+    qkv: ``[B, 3H, S, D]`` (q heads, then k heads, then v heads — exactly
+    ``einsum('bsi,iX->bXsd'-style)`` of the fused projection). Returns
+    ``[B, H, S, D]``.
+    """
+    if scale is None:
+        scale = 1.0 / (qkv.shape[-1] ** 0.5)
+    if not supported(qkv.shape[2], qkv.shape[3]):
+        raise ValueError(
+            f"causal_flash_qkv: unsupported shape {qkv.shape}; need "
+            f"S % 8 == 0, S <= {_MAX_SEQ}, D in (64,128,256)")
+    return _packed(qkv, num_heads, float(scale))

@@ -180,6 +180,92 @@ def _fwd(q, k, v, qp=None, kp=None, *, scale, causal, kv_valid, block_q, block_k
 # --------------------------------------------------------------------- bwd
 
 
+def fused_bwd_math(q, k, v, out, do, lse_col, *, scale, causal, kv_valid):
+    """Whole-sequence fused backward math on 2-D [S, D] operands — shared by
+    this module's _bwd_fused_kernel and causal_flash._bwd_kernel (one body,
+    two layouts). The logits are re-formed ONCE (the split dkv/dq kernel
+    pair re-forms them twice), delta = rowsum(dO*O) is computed in-kernel
+    (no [bh,sq,128] broadcast operands), and the five dots run in the input
+    dtype (bf16 on the train path) with fp32 accumulation — fp32 MXU dots
+    run at a fraction of bf16 rate, which made the old bwd the dominant
+    attention cost. Returns (dq, dk, dv) in fp32."""
+    sq, sk = q.shape[0], k.shape[0]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+    s = _mask_logits(s, causal=causal, kv_valid=kv_valid, block_q=sq,
+                     block_k=sk, iq=0, ik=0)
+    p = jnp.exp(s - lse_col)  # masked entries: exp(NEG_INF - finite) == 0
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # [sq, 1]
+    mxu = q.dtype
+    # dV = P^T @ dO
+    dv = jax.lax.dot_general(p.astype(mxu), do, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    # dP = dO @ V^T ; dS = P * (dP - delta)
+    dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    ds = (p * (dp - delta)).astype(mxu)
+    # dK = dS^T @ Q * scale ; dQ = dS @ K * scale
+    dk = jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    dq = jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32) * scale
+    return dq, dk, dv
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, out_ref, do_ref, lse_ref,
+                      dq_ref, dk_ref, dv_ref, *, scale, causal, kv_valid,
+                      sq, sk):
+    # lse arrives as a [1, 1, sq] row; relayout to a [sq, 1] column
+    lse_col = jnp.transpose(lse_ref[0], (1, 0))
+    dq, dk, dv = fused_bwd_math(
+        q_ref[0], k_ref[0], v_ref[0], out_ref[0], do_ref[0], lse_col,
+        scale=scale, causal=causal, kv_valid=kv_valid)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_fused(scale, causal, kv_valid, res, do):
+    """Fused whole-seq backward dispatch; caller guarantees sq·sk fits one
+    program's VMEM budget (see _FUSED_BWD_MAX_SEQ)."""
+    q, k, v, out, lse, _, _ = res
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    lse2d = lse[:, :, 0][:, None, :]  # [bh, 1, sq] f32 (TPU-tileable row)
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          kv_valid=kv_valid, sq=sq, sk=sk),
+        grid=(bh,),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, 1, sq), lambda b: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, sq, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, out, do, lse2d)
+    return dq, dk, dv
+
+
+# whole-seq fused bwd needs the [sq, sk] fp32 logits plus bf16 copies
+# resident in one program's VMEM; 1024x1024 ≈ 4 MB fp32 comfortably fits,
+# 2048 would push ~16 MB per fp32 temporary — stay on the split kernels there
+_FUSED_BWD_MAX_SEQ = 1024
+
+
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
                 scale, causal, kv_valid, block_q, block_k, num_q, pos_mask):
     if pos_mask:
@@ -210,18 +296,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         p = jnp.exp(s - lse_ref[0][:, :1])  # [bq, bk]
         if pos_mask:
             p = _guard_p(s, p)
-        do = do_ref[0].astype(jnp.float32)
+        do = do_ref[0]
+        mxu = q.dtype  # dots in input dtype (bf16 train path), f32 accum
         # dV += P^T @ dO
-        dv_s[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        dv_s[:] += jax.lax.dot_general(p.astype(mxu), do,
+                                       (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32)
         # dP = dO @ V^T ; dS = P * (dP - delta)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, :1])
+        ds = (p * (dp - delta_ref[0][:, :1])).astype(mxu)
         # dK += dS^T @ Q * scale
-        dk_s[:] += jax.lax.dot_general(ds, q.astype(jnp.float32),
-                                       (((0,), (0,)), ((), ())),
+        dk_s[:] += jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32) * scale
 
     @pl.when(iq == num_q - 1)
@@ -259,13 +345,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         p = jnp.exp(s - lse_ref[0][:, :1])
         if pos_mask:
             p = _guard_p(s, p)
-        do = do_ref[0].astype(jnp.float32)
-        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
-                                 (((1,), (1,)), ((), ())),
+        do = do_ref[0]
+        mxu = q.dtype
+        dp = jax.lax.dot_general(do, v_ref[0], (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, :1])
-        dq_s[:] += jax.lax.dot_general(ds, k.astype(jnp.float32),
-                                       (((1,), (0,)), ((), ())),
+        ds = (p * (dp - delta_ref[0][:, :1])).astype(mxu)
+        dq_s[:] += jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
                                        preferred_element_type=jnp.float32) * scale
 
     @pl.when(ik == num_kv - 1)
@@ -277,6 +362,10 @@ def _bwd(scale, causal, kv_valid, block_q, block_k, res, do, dlse=None):
     q, k, v, out, lse, qp, kp = res
     bh, sq, d = q.shape
     sk = k.shape[1]
+    if (qp is None and dlse is None and sq == sk
+            and sq <= _FUSED_BWD_MAX_SEQ):
+        # common train-path shape: one fused program per (batch·head)
+        return _bwd_fused(scale, causal, kv_valid, res, do)
     nq, nk = sq // block_q, sk // block_k
     pos_mask = qp is not None
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,

@@ -1,0 +1,87 @@
+"""Packed-QKV causal flash kernel (ops/pallas/causal_flash.py) — the v2
+train-path attention (VERDICT r2 #1 perf work). Twin-equivalence against
+the naive reference and against the general kernel path through the GPT
+model (reference capability: flash_attn_kernel.cu + the fused attention in
+fused_multi_transformer_op.cu)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas.causal_flash import causal_flash_qkv, supported
+
+
+@pytest.fixture
+def qkv(rng):
+    B, H, S, D = 2, 3, 256, 64
+    return jnp.asarray(rng.standard_normal((B, 3 * H, S, D)) * 0.3,
+                       jnp.float32)
+
+
+def _ref(qkv, H):
+    S, D = qkv.shape[2], qkv.shape[3]
+    q, k, v = qkv[:, :H], qkv[:, H:2 * H], qkv[:, 2 * H:]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, axis=-1), v)
+
+
+class TestPackedKernel:
+    def test_forward_matches_reference(self, qkv):
+        out = causal_flash_qkv(qkv, 3)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(qkv, 3)),
+                                   atol=2e-6)
+
+    def test_grads_match_reference(self, qkv, rng):
+        ct = jnp.asarray(rng.standard_normal((2, 3, 256, 64)) * 0.1,
+                         jnp.float32)
+        g1 = jax.grad(lambda x: jnp.sum(causal_flash_qkv(x, 3) * ct))(qkv)
+        g2 = jax.grad(lambda x: jnp.sum(_ref(x, 3) * ct))(qkv)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=5e-6)
+
+    def test_supported_predicate(self):
+        assert supported(1024, 64)
+        assert not supported(1030, 64)  # not multiple of 8
+        assert not supported(2048, 64)  # beyond whole-seq VMEM budget
+        assert not supported(256, 96)   # head dim not MXU-native
+
+
+class TestPackedInModel:
+    def test_gpt_train_step_equivalence(self, rng):
+        """Forcing the packed path must not change loss or grads vs the
+        general kernel path (twin equivalence at f32)."""
+        import paddle_tpu as paddle
+        from paddle_tpu.framework.flags import set_flags
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                        max_position=256, vocab_size=128)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        ids = paddle.to_tensor(
+            jnp.asarray(rng.integers(0, 128, (2, 256)), jnp.int32))
+        labels = paddle.to_tensor(
+            jnp.asarray(rng.integers(0, 128, (2, 256)), jnp.int32))
+
+        def loss_and_grads():
+            loss = model.loss(ids, labels)
+            loss.backward()
+            gs = {n: np.asarray(p.grad._data) for n, p in
+                  model.named_parameters() if p.grad is not None}
+            for p in model.parameters():
+                p.clear_grad()
+            return float(np.asarray(loss._data)), gs
+
+        set_flags({"FLAGS_use_packed_attention": False})
+        try:
+            l0, g0 = loss_and_grads()
+            set_flags({"FLAGS_use_packed_attention": True})
+            l1, g1 = loss_and_grads()
+        finally:
+            set_flags({"FLAGS_use_packed_attention": None})
+        assert np.isfinite(l0) and abs(l0 - l1) < 1e-4, (l0, l1)
+        assert g0.keys() == g1.keys() and len(g0) > 0
+        for name in g0:
+            np.testing.assert_allclose(g0[name], g1[name], atol=2e-3,
+                                       rtol=2e-3, err_msg=name)

@@ -107,21 +107,25 @@ class GPTAttention(nn.Layer):
                 and causal_flash.supported(s, self.head_dim))
 
     def _forward_packed(self, x):
-        """Zero-glue train path: qkv projection emitted as [b, 3H, s, D] and
-        the output projection consumed as [b, H, s, D] — beside the packed
-        kernel, every layout change lives inside an einsum where XLA folds
-        it into the GEMM (no transpose/unbind materialization)."""
-        from ..ops.pallas.causal_flash import causal_flash_qkv
+        """Zero-glue train path: qkv projection emitted as
+        [b, 3H/hpb, s, hpb*D] and the output projection consumed as
+        [b, H/hpb, s, hpb*D] — beside the packed kernel, every layout change
+        lives inside an einsum where XLA folds it into the GEMM (no
+        transpose/unbind materialization). hpb=2 pairs D=64 heads into full
+        128-lane tiles so no operand carries a 2x-padded layout."""
+        from ..ops.pallas.causal_flash import causal_flash_qkv, heads_per_block
 
         nh, hd = self.num_heads, self.head_dim
+        hpb = heads_per_block(nh, hd)
+        lanes = hpb * hd
 
         def fn(xa, wq, bq, wo, bo):
-            w3 = wq.reshape(xa.shape[-1], 3 * nh, hd).astype(xa.dtype)
-            b3 = bq.reshape(3 * nh, 1, hd).astype(xa.dtype)
-            qkv = jnp.einsum("bsi,iod->bosd", xa, w3) + b3
-            o = causal_flash_qkv(qkv, nh)
-            wo3 = wo.reshape(nh, hd, wo.shape[-1]).astype(xa.dtype)
-            return jnp.einsum("bhsd,hdo->bso", o, wo3) + bo.astype(xa.dtype)
+            w3 = wq.reshape(xa.shape[-1], 3 * nh // hpb, lanes).astype(xa.dtype)
+            b3 = bq.reshape(3 * nh // hpb, 1, lanes).astype(xa.dtype)
+            qkv = jnp.einsum("bsi,ipl->bpsl", xa, w3) + b3
+            o = causal_flash_qkv(qkv, nh, hd)
+            wo3 = wo.reshape(nh // hpb, lanes, wo.shape[-1]).astype(xa.dtype)
+            return jnp.einsum("bpsl,plo->bso", o, wo3) + bo.astype(xa.dtype)
 
         return apply_op(fn, x, self.qkv_proj.weight, self.qkv_proj.bias,
                         self.out_proj.weight, self.out_proj.bias)

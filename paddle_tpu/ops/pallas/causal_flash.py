@@ -7,21 +7,25 @@ design differs from ops/pallas/flash_attention.py (the general kernel) in
 two ways that dominate its speedup at train shapes:
 
 1. **Packed layout, zero glue.** Input is the QKV projection output viewed
-   as ``[B, 3H, S, D]`` and the output is ``[B, H, S, D]`` — both reachable
-   from the surrounding GEMMs by einsum alone, so XLA folds every layout
-   change into the matmuls and nothing materializes between GEMM and kernel
-   (the general kernel's [B,S,H,D]→[B*H,S,D] transposes + qkv unbind copies
-   cost ~0.4 ms/layer at GPT-medium scale). The same qkv array is passed
-   three times with different index maps — no slicing copies. The lse
-   residual is written as a [B, H, S, 1] column (the general kernel wrote a
-   128-lane broadcast, 64 MB of pure padding per layer).
+   as ``[B, 3H/hpb, S, hpb*D]`` and the output is ``[B, H/hpb, S, hpb*D]``
+   — both reachable from the surrounding GEMMs by einsum alone (the weight
+   is reshaped, the layout lands inside the dot), so nothing materializes
+   between GEMM and kernel (the general kernel's [B,S,H,D]→[B*H,S,D]
+   transposes + qkv unbind copies cost ~0.4 ms/layer at GPT-medium scale).
+   ``hpb`` (heads per lane block) is 2 for D=64 so the minor dimension is
+   128 lanes: a [..., 64] minor array takes a T(8,128) layout at 2.0x
+   padded footprint (seen directly in XLA's HBM analysis), doubling HBM
+   traffic for every operand — pair-packing removes the padding entirely.
+   The same qkv array is passed three times with different index maps — no
+   slicing copies. The lse residual is written as [B, H/hpb, S, hpb]
+   columns (the general kernel wrote a 128-lane broadcast, 64 MB of pure
+   padding per layer).
 2. **One fused backward.** dQ, dK, dV come out of a single whole-sequence
-   program per (batch, head) that forms the logits once (the split
-   dkv/dq kernel pair forms them twice), computes delta = rowsum(dO·O)
-   in-kernel, runs every dot in the input dtype (bf16 on the train path)
-   with fp32 accumulation, and writes all three grads into one
-   ``[B, 3, H, S, D]`` array that bitcasts to the packed layout the QKV
-   projection's backward consumes.
+   program per (batch, head block) — math shared with the general kernel
+   via flash_attention.fused_bwd_math (logits re-formed once, delta
+   in-kernel, dots in the input dtype with fp32 accumulation) — written
+   into one ``[B, 3, H/hpb, S, hpb*D]`` array that bitcasts to the packed
+   layout the QKV projection's backward consumes.
 
 Whole-sequence single-step programs deliberately pay the full S×S square
 (no causal skip): measured on v5e, Mosaic's cross-grid-step pipelining
@@ -40,7 +44,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1.0e30
 
@@ -61,40 +64,47 @@ def _causal_mask(s, sq, sk):
 # ---------------------------------------------------------------------- fwd
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, seq):
-    q = q_ref[0, 0]  # [S, D]
-    k = k_ref[0, 0]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32) * scale
-    s = _causal_mask(s, seq, seq)
-    m = jnp.max(s, axis=-1, keepdims=True)  # causal row 0 always sees col 0
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    acc = jax.lax.dot_general(p.astype(v_ref.dtype), v_ref[0, 0],
-                              (((1,), (0,)), ((), ())),
-                              preferred_element_type=jnp.float32)
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0, 0] = m + jnp.log(l)
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, seq, d, hpb):
+    for sub in range(hpb):  # static unroll over the heads sharing the lanes
+        lo = sub * d
+        q = q_ref[0, 0, :, lo:lo + d]  # [S, D]
+        k = k_ref[0, 0, :, lo:lo + d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = _causal_mask(s, seq, seq)
+        m = jnp.max(s, axis=-1, keepdims=True)  # causal row 0 sees col 0
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        acc = jax.lax.dot_general(p.astype(v_ref.dtype),
+                                  v_ref[0, 0, :, lo:lo + d],
+                                  (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        o_ref[0, 0, :, lo:lo + d] = (acc / l).astype(o_ref.dtype)
+        lse_ref[0, 0, :, sub:sub + 1] = m + jnp.log(l)
 
 
-def _fwd(qkv, num_heads, scale):
-    b, three_h, seq, d = qkv.shape
-    h = num_heads
+def _fwd(qkv, num_heads, head_dim, scale):
+    b, groups, seq, lanes = qkv.shape
+    hpb = lanes // head_dim
+    gh = num_heads // hpb  # head blocks
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, seq=seq),
-        grid=(b, h),
+        functools.partial(_fwd_kernel, scale=scale, seq=seq, d=head_dim,
+                          hpb=hpb),
+        grid=(b, gh),
         in_specs=[
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + h, 0, 0)),
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + 2 * h, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes),
+                         lambda bi, hi, gh=gh: (bi, hi + gh, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes),
+                         lambda bi, hi, gh=gh: (bi, hi + 2 * gh, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, seq, 1), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, hpb), lambda bi, hi: (bi, hi, 0, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, seq, d), qkv.dtype),
-            jax.ShapeDtypeStruct((b, h, seq, 1), jnp.float32),
+            jax.ShapeDtypeStruct((b, gh, seq, lanes), qkv.dtype),
+            jax.ShapeDtypeStruct((b, gh, seq, hpb), jnp.float32),
         ],
         interpret=_interpret(),
     )(qkv, qkv, qkv)
@@ -105,79 +115,101 @@ def _fwd(qkv, num_heads, scale):
 
 
 def _bwd_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dqkv_ref, *,
-                scale, seq):
+                scale, seq, d, hpb):
     from .flash_attention import fused_bwd_math
 
-    dq, dk, dv = fused_bwd_math(
-        q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], o_ref[0, 0], do_ref[0, 0],
-        lse_ref[0, 0], scale=scale, causal=True, kv_valid=None)
-    dqkv_ref[0, 0, 0] = dq.astype(dqkv_ref.dtype)
-    dqkv_ref[0, 1, 0] = dk.astype(dqkv_ref.dtype)
-    dqkv_ref[0, 2, 0] = dv.astype(dqkv_ref.dtype)
+    for sub in range(hpb):
+        lo = sub * d
+        dq, dk, dv = fused_bwd_math(
+            q_ref[0, 0, :, lo:lo + d], k_ref[0, 0, :, lo:lo + d],
+            v_ref[0, 0, :, lo:lo + d], o_ref[0, 0, :, lo:lo + d],
+            do_ref[0, 0, :, lo:lo + d], lse_ref[0, 0, :, sub:sub + 1],
+            scale=scale, causal=True, kv_valid=None)
+        dqkv_ref[0, 0, 0, :, lo:lo + d] = dq.astype(dqkv_ref.dtype)
+        dqkv_ref[0, 1, 0, :, lo:lo + d] = dk.astype(dqkv_ref.dtype)
+        dqkv_ref[0, 2, 0, :, lo:lo + d] = dv.astype(dqkv_ref.dtype)
 
 
-def _bwd(num_heads, scale, res, do):
+def _bwd(num_heads, head_dim, scale, res, do):
     qkv, out, lse = res
-    b, three_h, seq, d = qkv.shape
-    h = num_heads
+    b, groups, seq, lanes = qkv.shape
+    hpb = lanes // head_dim
+    gh = num_heads // hpb
     dqkv5 = pl.pallas_call(
-        functools.partial(_bwd_kernel, scale=scale, seq=seq),
-        grid=(b, h),
+        functools.partial(_bwd_kernel, scale=scale, seq=seq, d=head_dim,
+                          hpb=hpb),
+        grid=(b, gh),
         in_specs=[
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + h, 0, 0)),
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi + 2 * h, 0, 0)),
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, seq, d), lambda bi, hi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, seq, 1), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes),
+                         lambda bi, hi, gh=gh: (bi, hi + gh, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes),
+                         lambda bi, hi, gh=gh: (bi, hi + 2 * gh, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, lanes), lambda bi, hi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, seq, hpb), lambda bi, hi: (bi, hi, 0, 0)),
         ],
-        # one out array [B, 3, H, S, D]; the (1,3,1,S,D) block lets a single
-        # program write its head's dQ, dK, dV — reshaping to [B,3H,S,D] is a
-        # free bitcast for the caller
-        out_specs=pl.BlockSpec((1, 3, 1, seq, d),
+        # one out array [B, 3, H/hpb, S, hpb*D]; the (1,3,1,S,lanes) block
+        # lets a single program write its heads' dQ, dK, dV — reshaping to
+        # the packed [B, 3H/hpb, S, hpb*D] is a free bitcast for the caller
+        out_specs=pl.BlockSpec((1, 3, 1, seq, lanes),
                                lambda bi, hi: (bi, 0, hi, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, 3, h, seq, d), qkv.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, 3, gh, seq, lanes), qkv.dtype),
         interpret=_interpret(),
     )(qkv, qkv, qkv, out, do, lse)
-    return dqkv5.reshape(b, three_h, seq, d)
+    return dqkv5.reshape(b, 3 * gh, seq, lanes)
 
 
 # ------------------------------------------------------------------- public
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
-def _packed(qkv, num_heads, scale):
-    out, _ = _fwd(qkv, num_heads, scale)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _packed(qkv, num_heads, head_dim, scale):
+    out, _ = _fwd(qkv, num_heads, head_dim, scale)
     return out
 
 
-def _packed_fwd_rule(qkv, num_heads, scale):
-    out, lse = _fwd(qkv, num_heads, scale)
+def _packed_fwd_rule(qkv, num_heads, head_dim, scale):
+    out, lse = _fwd(qkv, num_heads, head_dim, scale)
     return out, (qkv, out, lse)
 
 
-def _packed_bwd_rule(num_heads, scale, res, do):
-    return (_bwd(num_heads, scale, res, do),)
+def _packed_bwd_rule(num_heads, head_dim, scale, res, do):
+    return (_bwd(num_heads, head_dim, scale, res, do),)
 
 
 _packed.defvjp(_packed_fwd_rule, _packed_bwd_rule)
+
+
+def heads_per_block(num_heads: int, head_dim: int) -> int:
+    """2 when pair-packing D=64 heads into full 128-lane tiles is possible
+    (even head count), else 1."""
+    return 2 if (head_dim == 64 and num_heads % 2 == 0) else 1
 
 
 def supported(seq: int, head_dim: int) -> bool:
     return seq % 8 == 0 and seq <= _MAX_SEQ and head_dim in (64, 128, 256)
 
 
-def causal_flash_qkv(qkv, num_heads, scale=None):
+def causal_flash_qkv(qkv, num_heads, head_dim=None):
     """Causal self-attention on a packed QKV tensor.
 
-    qkv: ``[B, 3H, S, D]`` (q heads, then k heads, then v heads — exactly
-    ``einsum('bsi,iX->bXsd'-style)`` of the fused projection). Returns
-    ``[B, H, S, D]``.
+    qkv: ``[B, 3H/hpb, S, hpb*D]`` — q head blocks, then k, then v, where
+    ``hpb = heads_per_block(H, D)`` (exactly the reshaped-weight einsum of
+    the fused projection). Returns ``[B, H/hpb, S, hpb*D]``.
     """
-    if scale is None:
-        scale = 1.0 / (qkv.shape[-1] ** 0.5)
-    if not supported(qkv.shape[2], qkv.shape[3]):
+    b, groups, seq, lanes = qkv.shape
+    if head_dim is None:
+        head_dim = lanes  # hpb == 1 call style
+    hpb = lanes // head_dim
+    if (lanes % head_dim or num_heads % hpb
+            or groups * hpb != 3 * num_heads):
+        raise ValueError(
+            f"causal_flash_qkv: qkv shape {qkv.shape} inconsistent with "
+            f"num_heads={num_heads}, head_dim={head_dim}")
+    if not supported(seq, head_dim):
         raise ValueError(
             f"causal_flash_qkv: unsupported shape {qkv.shape}; need "
             f"S % 8 == 0, S <= {_MAX_SEQ}, D in (64,128,256)")
-    return _packed(qkv, num_heads, float(scale))
+    scale = 1.0 / (head_dim ** 0.5)
+    return _packed(qkv, num_heads, head_dim, float(scale))

@@ -46,16 +46,46 @@ class TestPackedKernel:
         assert not supported(2048, 64)  # beyond whole-seq VMEM budget
         assert not supported(256, 96)   # head dim not MXU-native
 
+    def test_pair_packed_matches_reference(self, rng):
+        """hpb=2 lane pairing (D=64, even heads) must equal per-head attn."""
+        from paddle_tpu.ops.pallas.causal_flash import heads_per_block
+
+        B, H, S, D = 2, 4, 256, 64
+        assert heads_per_block(H, D) == 2
+        # heads laid out in pairs along the lane dim: [B, 3H/2, S, 128]
+        per_head = jnp.asarray(
+            rng.standard_normal((B, 3 * H, S, D)) * 0.3, jnp.float32)
+        paired = per_head.reshape(B, 3 * H // 2, 2, S, D).transpose(
+            0, 1, 3, 2, 4).reshape(B, 3 * H // 2, S, 2 * D)
+        out = causal_flash_qkv(paired, H, D)
+        ref = _ref(per_head, H)  # [B, H, S, D]
+        ref_paired = ref.reshape(B, H // 2, 2, S, D).transpose(
+            0, 1, 3, 2, 4).reshape(B, H // 2, S, 2 * D)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref_paired),
+                                   atol=2e-6)
+        # grads through the pair-packed bwd
+        ct = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.float32)
+        g1 = jax.grad(
+            lambda x: jnp.sum(causal_flash_qkv(x, H, D) * ct))(paired)
+        g2 = jax.grad(lambda x: jnp.sum(
+            _ref(x, H).reshape(B, H // 2, 2, S, D).transpose(0, 1, 3, 2, 4)
+            .reshape(B, H // 2, S, 2 * D) * ct))(per_head)
+        g2p = g2.reshape(B, 3 * H // 2, 2, S, D).transpose(
+            0, 1, 3, 2, 4).reshape(B, 3 * H // 2, S, 2 * D)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2p), atol=5e-6)
+
 
 class TestPackedInModel:
-    def test_gpt_train_step_equivalence(self, rng):
+    @pytest.mark.parametrize("hidden,heads", [(128, 2),   # hpb=2 pairing
+                                              (192, 3)])  # hpb=1 (odd heads)
+    def test_gpt_train_step_equivalence(self, rng, hidden, heads):
         """Forcing the packed path must not change loss or grads vs the
         general kernel path (twin equivalence at f32)."""
         import paddle_tpu as paddle
         from paddle_tpu.framework.flags import set_flags
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 
-        cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+        cfg = GPTConfig(hidden_size=hidden, num_layers=2, num_heads=heads,
                         max_position=256, vocab_size=128)
         model = GPTForCausalLM(cfg)
         model.eval()

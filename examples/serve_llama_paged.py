@@ -1,11 +1,13 @@
-"""LLaMA serving over the paged KV cache — continuous-batching-style slots
-(reference capability: fused_multi_transformer_op.cu decode serving +
-PaddleNLP llama; TPU stack: GQA decode kernel + block-table page pool,
+"""LLaMA serving through the continuous-batching engine
+(reference capability: analysis_predictor serving loop +
+fused_multi_transformer_op.cu decode; TPU stack: inference.Engine over the
+paged KV cache — compiled decode chunks, block-table page pool,
 paddle_tpu/ops/pallas/paged_attention.py).
 
-Demonstrates the serving memory model the reference's contiguous cache
-can't give you: sequences of different lengths share one page pool, a
-finished sequence's pages are recycled for the next request.
+Demonstrates what the reference's contiguous cache can't give you:
+sequences of different lengths share one page pool, a finished request's
+pages recycle into the next admission mid-flight (no head-of-line
+blocking), and tokens stream back per chunk.
 
 Run (tiny, CPU ok):
     env PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python examples/serve_llama_paged.py --tiny
@@ -30,9 +32,8 @@ def main():
 
     import jax.numpy as jnp
 
-    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.inference.engine import Engine
     from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
-    from paddle_tpu.ops.pallas import PagedKVCache
 
     paddle.seed(0)
     cfg = tiny_llama_config() if args.tiny else tiny_llama_config(
@@ -41,48 +42,35 @@ def main():
     model = LlamaForCausalLM(cfg)
     model.eval()
 
-    batch_slots, page_size = 4, 16
-    caches = [
-        PagedKVCache(num_pages=64, page_size=page_size,
-                     batch_size=batch_slots, num_kv_heads=cfg.num_kv_heads,
-                     head_dim=cfg.head_dim,
-                     max_pages_per_seq=cfg.max_position // page_size,
-                     dtype=jnp.float32, quantized=args.int8_cache)
-        for _ in range(cfg.num_layers)
-    ]
-
+    eng = Engine(model, max_slots=4, num_pages=96, page_size=16,
+                 chunk_size=8, dtype=jnp.float32,
+                 quantized_cache=args.int8_cache)
     rng = np.random.default_rng(0)
 
-    def serve_round(prompt_len, new_tokens):
-        ids = jnp.asarray(
-            rng.integers(0, cfg.vocab_size, (batch_slots, prompt_len)),
-            jnp.int32)
-        # prefill writes prompt K/V into fresh pages
-        logits, _ = model(Tensor._wrap(ids), caches=caches)
-        last = (logits._data if hasattr(logits, "_data") else logits)[:, -1]
-        tok = jnp.argmax(last, axis=-1).astype(jnp.int32)
-        outs = [tok]
-        for step in range(prompt_len, prompt_len + new_tokens - 1):
-            logits, _ = model(Tensor._wrap(tok[:, None]), caches=caches,
-                              time_step=step)
-            lg = logits._data if hasattr(logits, "_data") else logits
-            tok = jnp.argmax(lg[:, -1], axis=-1).astype(jnp.int32)
-            outs.append(tok)
-        return np.stack([np.asarray(t) for t in outs], axis=1)
+    # mixed-length requests, more requests than slots: admission interleaves
+    # with decode, finished slots recycle their pages for queued requests
+    streams = {}
+    reqs = []
+    for i, (plen, new) in enumerate([(20, 12), (33, 6), (8, 24), (27, 10),
+                                     (15, 16), (41, 8)]):
+        prompt = rng.integers(0, cfg.vocab_size, (plen,))
+        streams[i] = []
+        reqs.append(eng.add_request(
+            prompt, new, on_token=lambda ts, i=i: streams[i].extend(ts)))
 
-    free0 = len(caches[0]._free)
-    toks = serve_round(prompt_len=20, new_tokens=8)
-    used = free0 - len(caches[0]._free)
-    print(f"round 1: generated {toks.shape} tokens; pages in use/layer: {used}")
+    free0 = len(eng._free_pages)
+    rounds = 0
+    while eng.step():
+        rounds += 1
+        in_use = free0 - len(eng._free_pages)
+        print(f"round {rounds}: active={len(eng._active)} "
+              f"queued={len(eng._queue)} pages_in_use={in_use}")
 
-    # finished requests release their pages back to the pool
-    for c in caches:
-        for slot in range(batch_slots):
-            c.free(slot)
-    print(f"pages recycled: pool back to {len(caches[0]._free)}/{free0}")
-
-    toks2 = serve_round(prompt_len=33, new_tokens=5)  # different lengths OK
-    print(f"round 2: generated {toks2.shape} tokens "
+    for i, r in enumerate(reqs):
+        assert r.done and streams[i] == r.tokens
+        print(f"request {r.rid}: prompt {r.prompt.size:>2} -> "
+              f"{len(r.tokens)} tokens (streamed {len(streams[i])})")
+    print(f"pool fully recycled: {len(eng._free_pages)}/{free0} free "
           f"(int8_cache={args.int8_cache})")
 
 

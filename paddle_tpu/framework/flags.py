@@ -57,4 +57,7 @@ define_flag("FLAGS_use_packed_attention", None,
 define_flag("FLAGS_flash_attn_block_q", 128, "flash attention q tile")
 define_flag("FLAGS_flash_attn_block_k", 128, "flash attention kv tile")
 define_flag("FLAGS_check_nan_inf", False, "enable debug nan checks in optimizer steps")
+define_flag("FLAGS_decode_attention_kernel", False,
+            "use the Pallas decode-attention kernel instead of the XLA "
+            "batched-matvec path (measured slower at decode shapes on v5e)")
 define_flag("FLAGS_log_level", "INFO", "python log level")

@@ -308,19 +308,21 @@ class FusedMultiTransformer(nn.Layer):
             return F.flash_attention(q, k, v, causal=True,
                                      training=self.training)[0]
 
-        from ....ops.pallas.paged_attention import PagedKVCache
+        from ....ops.pallas.paged_attention import (PagedCacheState,
+                                                    PagedKVCache)
 
         if cache is None:
             out = ctx_attention()
-        elif isinstance(cache, PagedKVCache):
+        elif isinstance(cache, (PagedKVCache, PagedCacheState)):
             # paged/block cache (serving path): the manager mutates host-side
             # block tables and functional page arrays; inference-only (no
             # tape node — gradients don't flow through a serving cache)
             from ....ops.pallas.paged_attention import paged_forward
 
-            res = paged_forward(cache, q, k, v, time_step, ctx_attention)
-            out = res if isinstance(res, Tensor) else Tensor._wrap(res)
-            new_cache = cache
+            out_raw, new_cache = paged_forward(cache, q, k, v, time_step,
+                                               ctx_attention)
+            out = (out_raw if isinstance(out_raw, Tensor)
+                   else Tensor._wrap(out_raw))
         elif time_step is None:
             # context phase: write prompt k/v at positions [0, s)
             from ....ops.pallas.decode_attention import cache_prefill_write

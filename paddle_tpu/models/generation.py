@@ -136,7 +136,12 @@ class GenerationMixin:
         if max_new_tokens <= 0:
             return Tensor._wrap(ids)
         total = max_seq or min(self.config.max_position, prompt + max_new_tokens)
-        caches = [c._data for c in self.init_caches(b, total)]
+        # KV cache in the model's compute dtype: a bf16-cast model must not
+        # pay fp32 cache bandwidth in the decode loop (2x the HBM traffic)
+        pdtype = next(p._data.dtype for _, p in self.named_parameters())
+        if not jnp.issubdtype(pdtype, jnp.floating):
+            pdtype = jnp.float32
+        caches = [c._data for c in self.init_caches(b, total, dtype=pdtype)]
 
         # prefill: one compiled pass over the prompt
         params, _ = self._swapped_params()

@@ -77,10 +77,24 @@ def gpt3_6p7b():
 
 def _is_paged(cache) -> bool:
     """isinstance check with a lazy import (isinstance — not a name compare —
-    so PagedKVCache subclasses dispatch correctly)."""
-    from ..ops.pallas.paged_attention import PagedKVCache
+    so PagedKVCache subclasses dispatch correctly). Covers both the
+    host-managed PagedKVCache and the functional PagedCacheState the
+    compiled serving engine threads through jit."""
+    from ..ops.pallas.paged_attention import PagedCacheState, PagedKVCache
 
-    return isinstance(cache, PagedKVCache)
+    return isinstance(cache, (PagedKVCache, PagedCacheState))
+
+
+def _paged_positions(caches, s):
+    """Per-slot positions for a functional paged batch: slot b's tokens sit
+    at [lengths[b], lengths[b]+s) — ragged across the batch (the advisor's
+    r2 finding against one scalar time_step for all slots). None when the
+    cache is not a functional paged state."""
+    from ..ops.pallas.paged_attention import PagedCacheState
+
+    if caches and isinstance(caches[0], PagedCacheState):
+        return caches[0].lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    return None
 
 
 class GPTAttention(nn.Layer):
@@ -147,12 +161,12 @@ class GPTAttention(nn.Layer):
             # serving path: block-table page pool
             from ..ops.pallas.paged_attention import paged_forward
 
-            res = paged_forward(
+            out_raw, new_cache = paged_forward(
                 cache, q, k, v, time_step,
                 lambda: F.flash_attention(q, k, v, causal=True,
                                           training=False)[0])
-            out = res if isinstance(res, Tensor) else Tensor._wrap(res)
-            new_cache = cache
+            out = (out_raw if isinstance(out_raw, Tensor)
+                   else Tensor._wrap(out_raw))
         elif time_step is None:
             # prefill: causal attention over the prompt, cache k/v at [0, s)
             from ..ops.pallas.decode_attention import cache_prefill_write
@@ -218,8 +232,12 @@ class GPTModel(nn.Layer):
 
     def forward(self, input_ids, caches=None, time_step=None):
         b, s = input_ids.shape
-        offset = 0 if time_step is None else time_step
-        pos = Tensor._wrap(jnp.arange(s, dtype=jnp.int32)[None, :] + offset)
+        ragged = _paged_positions(caches, s)
+        if ragged is not None:
+            pos = Tensor._wrap(ragged)
+        else:
+            offset = 0 if time_step is None else time_step
+            pos = Tensor._wrap(jnp.arange(s, dtype=jnp.int32)[None, :] + offset)
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
         if caches is None:
@@ -233,11 +251,17 @@ class GPTModel(nn.Layer):
         return self.ln_f(x), new_caches
 
     def init_caches(self, batch_size, max_seq, dtype=jnp.float32):
-        """KV caches, reference layout [2, bsz, nh, max_seq, hd] per layer
-        (fused_multi_transformer_op.cu cache layout)."""
+        """KV caches (reference capability: the [2,bsz,nh,S,hd] cache of
+        fused_multi_transformer_op.cu) in the TPU slab layout
+        [2, bsz, S, nh*hd] — unpadded 128-lane minor; the per-head layout's
+        64-wide minor takes a 2x padded XLA layout that doubles decode-loop
+        HBM traffic. cache_decode_step dispatches on ndim."""
         cfg = self.config
-        shape = (2, batch_size, cfg.num_heads, max_seq, cfg.head_dim)
-        return [Tensor._wrap(jnp.zeros(shape, dtype)) for _ in range(cfg.num_layers)]
+        from ..ops.pallas.decode_attention import make_kv_slab
+
+        return [Tensor._wrap(make_kv_slab(batch_size, max_seq,
+                                          cfg.num_heads, cfg.head_dim, dtype))
+                for _ in range(cfg.num_layers)]
 
 
 class GPTForCausalLM(GenerationMixin, nn.Layer):

@@ -75,11 +75,11 @@ def tiny_llama_config(**kw):
 
 
 def _is_paged(cache) -> bool:
-    """isinstance check with a lazy import (isinstance — not a name compare —
-    so PagedKVCache subclasses dispatch correctly)."""
-    from ..ops.pallas.paged_attention import PagedKVCache
+    """One shared predicate with GPT (covers PagedKVCache and the engine's
+    functional PagedCacheState)."""
+    from .gpt import _is_paged as _gpt_is_paged
 
-    return isinstance(cache, PagedKVCache)
+    return _gpt_is_paged(cache)
 
 
 class LlamaAttention(nn.Layer):
@@ -95,14 +95,24 @@ class LlamaAttention(nn.Layer):
         self.v_proj = nn.Linear(h, config.num_kv_heads * hd, bias_attr=False)
         self.o_proj = nn.Linear(config.num_heads * hd, h, bias_attr=False)
 
-    def _rope(self, q, k, time_step):
+    def _rope(self, q, k, time_step, cache=None):
         from ..incubate.nn.functional import fused_rotary_position_embedding
+        from ..ops.pallas.paged_attention import PagedCacheState
 
-        if time_step is None:
+        b, s = (q._data if isinstance(q, Tensor) else q).shape[:2]
+        if isinstance(cache, PagedCacheState):
+            # per-slot positions — ragged serving batches rotate each slot
+            # at its own length (advisor r2: one scalar time_step mis-rotates
+            # every slot but slot 0)
+            pos = apply_op(
+                lambda: cache.lengths[:, None]
+                + jnp.arange(s, dtype=jnp.int32)[None])
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, position_ids=pos, rotary_emb_base=self.rope_theta)
+        elif time_step is None:
             q, k, _ = fused_rotary_position_embedding(
                 q, k, rotary_emb_base=self.rope_theta)
         else:
-            b, s = (q._data if isinstance(q, Tensor) else q).shape[:2]
             pos = apply_op(
                 lambda: jnp.broadcast_to(
                     jnp.arange(s, dtype=jnp.int32)[None] + time_step, (b, s)))
@@ -116,7 +126,7 @@ class LlamaAttention(nn.Layer):
         q = self.q_proj(x).reshape([b, s, nh, hd])
         k = self.k_proj(x).reshape([b, s, nkv, hd])
         v = self.v_proj(x).reshape([b, s, nkv, hd])
-        q, k = self._rope(q, k, time_step)
+        q, k = self._rope(q, k, time_step, cache)
         new_cache = None
         group = nh // nkv
 
@@ -132,12 +142,12 @@ class LlamaAttention(nn.Layer):
             # serving path: block-table page pool (GQA native in the kernel)
             from ..ops.pallas.paged_attention import paged_forward
 
-            res = paged_forward(
+            out_raw, new_cache = paged_forward(
                 cache, q, k, v, time_step,
                 lambda: F.flash_attention(q, expand_kv(k), expand_kv(v),
                                           causal=True, training=False)[0])
-            out = res if isinstance(res, Tensor) else Tensor._wrap(res)
-            new_cache = cache
+            out = (out_raw if isinstance(out_raw, Tensor)
+                   else Tensor._wrap(out_raw))
         elif time_step is None:
             from ..ops.pallas.decode_attention import cache_prefill_write
 
@@ -219,11 +229,16 @@ class LlamaModel(nn.Layer):
         return self.norm(x), new_caches
 
     def init_caches(self, batch_size, max_seq, dtype=jnp.float32):
-        """Reference cache layout [2, b, n_kv_heads, max_seq, head_dim]
-        (fused_multi_transformer_op.cu convention, GQA-narrow)."""
+        """KV caches (reference capability: the GQA-narrow
+        [2,b,n_kv_heads,S,hd] cache of fused_multi_transformer_op.cu) in the
+        TPU slab layout [2, b, S, n_kv_heads*hd] — see GPTModel.init_caches
+        for the layout rationale."""
         cfg = self.config
-        shape = (2, batch_size, cfg.num_kv_heads, max_seq, cfg.head_dim)
-        return [Tensor._wrap(jnp.zeros(shape, dtype))
+        from ..ops.pallas.decode_attention import make_kv_slab
+
+        return [Tensor._wrap(make_kv_slab(batch_size, max_seq,
+                                          cfg.num_kv_heads, cfg.head_dim,
+                                          dtype))
                 for _ in range(cfg.num_layers)]
 
 

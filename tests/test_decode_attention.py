@@ -64,6 +64,54 @@ class TestDecodeKernel:
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
 
+class TestSlabKernel:
+    """Slab-layout decode path (cache [2,B,S,Hkv*D]) — the serving-loop
+    fast path; _slab_pallas exercised in interpret mode, plus the
+    layout-polymorphic cache_decode_step dispatch."""
+
+    @pytest.mark.parametrize("b,h,hkv,s,d", [(2, 4, 4, 16, 32),
+                                             (1, 4, 2, 24, 64)])
+    def test_slab_pallas_interpret(self, rng, b, h, hkv, s, d):
+        from paddle_tpu.ops.pallas.decode_attention import _slab_pallas
+
+        q = rng.standard_normal((b, h, d)).astype(np.float32)
+        kc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+        vc = rng.standard_normal((b, hkv, s, d)).astype(np.float32)
+        lengths = rng.integers(1, s + 1, (b,)).astype(np.int32)
+        slab = jnp.stack([
+            jnp.swapaxes(jnp.asarray(kc), 1, 2).reshape(b, s, hkv * d),
+            jnp.swapaxes(jnp.asarray(vc), 1, 2).reshape(b, s, hkv * d)])
+        got = np.asarray(_slab_pallas(jnp.asarray(q), slab, lengths,
+                                      1.0 / np.sqrt(d)))
+        want = numpy_decode(q, np.repeat(kc, h // hkv, 1),
+                            np.repeat(vc, h // hkv, 1), lengths)
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_cache_decode_step_slab_vs_reference_layout(self, rng):
+        """The 4-D slab path and the 5-D reference-layout path must produce
+        identical outputs and equivalent cache contents."""
+        from paddle_tpu.ops.pallas.decode_attention import (
+            cache_decode_step, cache_prefill_write, make_kv_slab)
+
+        b, nh, smax, hd = 2, 4, 12, 16
+        k0 = jnp.asarray(rng.standard_normal((b, 5, nh, hd)), jnp.float32)
+        v0 = jnp.asarray(rng.standard_normal((b, 5, nh, hd)), jnp.float32)
+        q = jnp.asarray(rng.standard_normal((b, 1, nh, hd)), jnp.float32)
+        k1 = jnp.asarray(rng.standard_normal((b, 1, nh, hd)), jnp.float32)
+        v1 = jnp.asarray(rng.standard_normal((b, 1, nh, hd)), jnp.float32)
+
+        slab = cache_prefill_write(make_kv_slab(b, smax, nh, hd), k0, v0)
+        ref5 = cache_prefill_write(
+            jnp.zeros((2, b, nh, smax, hd), jnp.float32), k0, v0)
+        out_s, slab = cache_decode_step(slab, q, k1, v1, 5)
+        out_r, ref5 = cache_decode_step(ref5, q, k1, v1, 5)
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r),
+                                   rtol=2e-5, atol=2e-5)
+        slab_as5 = slab.reshape(2, b, smax, nh, hd).transpose(0, 1, 3, 2, 4)
+        np.testing.assert_allclose(np.asarray(slab_as5), np.asarray(ref5),
+                                   rtol=1e-6, atol=1e-6)
+
+
 class TestMaskedMHA:
     def test_functional_updates_cache_and_matches_ref(self, rng):
         from paddle_tpu.incubate.nn.functional import masked_multihead_attention

@@ -1,0 +1,138 @@
+"""Continuous-batching serving engine (VERDICT r2 #3; reference capability:
+analysis_predictor serving loop + fused_multi_transformer decode). Checks:
+mixed-length admission without head-of-line blocking, page recycling,
+greedy-decode equivalence with the contiguous cache path, streaming
+callbacks, ragged per-slot positions, and the int8 page variant."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                    max_position=128, vocab_size=97)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+class TestEngine:
+    def test_mixed_lengths_match_contiguous_greedy(self, gpt, rng):
+        eng = Engine(gpt, max_slots=3, num_pages=64, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        prompts = [rng.integers(0, 97, (n,)) for n in (5, 12, 9, 7)]
+        reqs = [eng.add_request(p, 10) for p in prompts]
+        eng.run()
+        assert all(r.done and len(r.tokens) == 10 for r in reqs)
+        for r, p in zip(reqs, prompts):
+            want = gpt.generate(Tensor._wrap(jnp.asarray(p[None])),
+                                max_new_tokens=10, temperature=0.0)
+            np.testing.assert_array_equal(
+                r.tokens, np.asarray(want)[0, p.size:],
+                err_msg=f"request {r.rid} (prompt {p.size})")
+
+    def test_no_head_of_line_blocking_and_page_recycling(self, gpt, rng):
+        """A short request must finish and its recycled slot serve a queued
+        request while a long request is still decoding."""
+        eng = Engine(gpt, max_slots=2, num_pages=48, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        long_r = eng.add_request(rng.integers(0, 97, (6,)), 40)
+        short_r = eng.add_request(rng.integers(0, 97, (6,)), 4)
+        queued = eng.add_request(rng.integers(0, 97, (6,)), 4)
+        free0 = len(eng._free_pages)
+        # run a few steps: short finishes, queued admits, long still going
+        for _ in range(3):
+            eng.step()
+        assert short_r.done
+        assert queued.tokens, "queued request never admitted"
+        assert not long_r.done
+        eng.run()
+        assert long_r.done and queued.done
+        assert len(eng._free_pages) == free0  # every page recycled
+        assert np.all(eng.tables == 0) and np.all(eng.lengths == 0)
+        assert not eng._active and not eng._queue
+
+    def test_streaming_callback(self, gpt, rng):
+        eng = Engine(gpt, max_slots=2, num_pages=48, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        seen = []
+        req = eng.add_request(rng.integers(0, 97, (5,)), 9,
+                              on_token=lambda ts: seen.extend(ts))
+        eng.run()
+        assert seen == req.tokens and len(seen) == 9
+
+    def test_int8_paged_engine_close_to_fp32(self, gpt, rng):
+        p = rng.integers(0, 97, (9,))
+        eng8 = Engine(gpt, max_slots=2, num_pages=48, page_size=8,
+                      chunk_size=4, dtype=jnp.float32, quantized_cache=True)
+        r8 = eng8.add_request(p, 8)
+        eng8.run()
+        assert r8.done and len(r8.tokens) == 8
+        # int8 KV rounding can flip ties; require a majority token match
+        want = gpt.generate(Tensor._wrap(jnp.asarray(p[None])),
+                            max_new_tokens=8, temperature=0.0)
+        agree = sum(int(a == b) for a, b in
+                    zip(r8.tokens, np.asarray(want)[0, p.size:].tolist()))
+        assert agree >= 5, (r8.tokens, np.asarray(want)[0, p.size:])
+
+    def test_llama_gqa_through_engine(self, rng):
+        paddle.seed(1)
+        cfg = LlamaConfig(vocab_size=89, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2, intermediate_size=128,
+                          max_position=128)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        eng = Engine(model, max_slots=2, num_pages=48, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        prompts = [rng.integers(0, 89, (n,)) for n in (6, 11)]
+        reqs = [eng.add_request(p, 8) for p in prompts]
+        eng.run()
+        for r, p in zip(reqs, prompts):
+            want = model.generate(Tensor._wrap(jnp.asarray(p[None])),
+                                  max_new_tokens=8, temperature=0.0)
+            np.testing.assert_array_equal(
+                r.tokens, np.asarray(want)[0, p.size:],
+                err_msg=f"llama request prompt {p.size}")
+
+    def test_single_token_prompt(self, gpt, rng):
+        """A 1-token prompt must route through prefill, not the decode
+        append path (code-review r3 finding)."""
+        p = rng.integers(0, 97, (1,))
+        eng = Engine(gpt, max_slots=2, num_pages=48, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        r = eng.add_request(p, 6)
+        eng.run()
+        want = gpt.generate(Tensor._wrap(jnp.asarray(p[None])),
+                            max_new_tokens=6, temperature=0.0)
+        np.testing.assert_array_equal(r.tokens, np.asarray(want)[0, 1:])
+
+    def test_impossible_request_fails_fast(self, gpt):
+        eng = Engine(gpt, max_slots=2, num_pages=8, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="pages"):
+            eng.add_request(np.zeros(90, np.int32), 20)
+
+    def test_pool_pressure_preempts_and_completes(self, gpt, rng):
+        """Two long requests that can't BOTH hold their full generations:
+        preemption (recompute policy) must let both finish with greedy
+        results identical to the contiguous path."""
+        # pool sized so one full request fits comfortably but two at full
+        # length cannot coexist (each needs ~8 pages at the end)
+        eng = Engine(gpt, max_slots=2, num_pages=13, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        prompts = [rng.integers(0, 97, (16,)) for _ in range(2)]
+        reqs = [eng.add_request(p, 36) for p in prompts]
+        eng.run()
+        assert all(r.done and len(r.tokens) == 36 for r in reqs)
+        for r, p in zip(reqs, prompts):
+            want = gpt.generate(Tensor._wrap(jnp.asarray(p[None])),
+                                max_new_tokens=36, temperature=0.0)
+            np.testing.assert_array_equal(r.tokens, np.asarray(want)[0, 16:])

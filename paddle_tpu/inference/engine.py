@@ -107,7 +107,15 @@ class Engine:
         # before the host harvests — leave that headroom below max_position
         limit = self.cfg.max_position - self.chunk_size - 1
         if prompt.size + max_new_tokens > limit:
-            max_new_tokens = max(0, limit - prompt.size)
+            import warnings
+
+            clamped = max(0, limit - prompt.size)
+            warnings.warn(
+                f"max_new_tokens clamped {max_new_tokens} -> {clamped}: "
+                f"prompt ({prompt.size}) + generation must stay under "
+                f"max_position - chunk_size ({limit})", RuntimeWarning,
+                stacklevel=2)
+            max_new_tokens = clamped
         # fail fast on a request that could NEVER be served — otherwise the
         # scheduler would spin forever waiting for pages that cannot exist
         worst = self._pages_needed(prompt.size + max_new_tokens
@@ -315,7 +323,8 @@ class Engine:
         """Append generated tokens to a request, honoring eos/max."""
         fresh = []
         for t in toks:
-            if req.done:
+            if req.done or len(req.tokens) >= req.max_new_tokens:
+                req.done = True
                 break
             req.tokens.append(int(t))
             fresh.append(int(t))

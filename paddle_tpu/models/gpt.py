@@ -93,7 +93,7 @@ def _paged_positions(caches, s):
     from ..ops.pallas.paged_attention import PagedCacheState
 
     if caches and isinstance(caches[0], PagedCacheState):
-        return caches[0].lengths[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+        return caches[0].positions(s)
     return None
 
 

@@ -104,9 +104,7 @@ class LlamaAttention(nn.Layer):
             # per-slot positions — ragged serving batches rotate each slot
             # at its own length (advisor r2: one scalar time_step mis-rotates
             # every slot but slot 0)
-            pos = apply_op(
-                lambda: cache.lengths[:, None]
-                + jnp.arange(s, dtype=jnp.int32)[None])
+            pos = apply_op(lambda: cache.positions(s))
             q, k, _ = fused_rotary_position_embedding(
                 q, k, position_ids=pos, rotary_emb_base=self.rope_theta)
         elif time_step is None:

@@ -1,5 +1,5 @@
 """paddle_tpu.nn — the Layer system + layer library (reference: python/paddle/nn/)."""
-from . import functional, initializer
+from . import functional, initializer, quant
 from .activation import *  # noqa: F401,F403
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .common import *  # noqa: F401,F403

@@ -1,0 +1,116 @@
+"""Weight-only quantization for the decode path.
+
+Reference capability: ``paddle.nn.quant.weight_quantize`` /
+``weight_only_linear`` backing ``fused_multi_transformer_int8_op.cu``
+(SURVEY A3.x) — small-batch decode is weight-bandwidth-bound, so int8
+weights halve the dominant HBM traffic. TPU design: weights are STORED
+int8 with one f32 scale per output channel (symmetric); the matmul runs
+``x @ convert(W_int8)`` — XLA fuses the convert into the dot's operand
+load, so only int8 bytes cross HBM — and the per-channel scale multiplies
+the f32/bf16 output. No custom kernel needed; the bandwidth win is the
+storage dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from .layer import Layer
+
+__all__ = ["weight_quantize", "weight_only_linear", "WeightOnlyLinear",
+           "quantize_for_decode"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor._wrap(jnp.asarray(x))
+
+
+def weight_quantize(x, algo="weight_only_int8"):
+    """Per-output-channel symmetric int8 quantization of a [in, out] weight.
+    Returns ``(int8 weight [in, out], f32 scales [out])``."""
+    if algo != "weight_only_int8":
+        raise NotImplementedError(
+            f"weight_quantize: only 'weight_only_int8' is supported "
+            f"(got {algo!r}); int4 is a recorded gap")
+    w = _t(x)._data
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scales[None, :]),
+                 -127, 127).astype(jnp.int8)
+    return Tensor._wrap(q), Tensor._wrap(scales)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8"):
+    """y = x @ dequant(W) + b with int8-stored W (reference:
+    paddle.nn.quant.weight_only_linear)."""
+    if weight_dtype != "int8":
+        raise NotImplementedError("weight_only_linear: int8 only")
+    args = [_t(x), _t(weight), _t(weight_scale)]
+    has_bias = bias is not None
+    if has_bias:
+        args.append(_t(bias))
+
+    def fn(xa, wq, sc, *b):
+        y = jnp.dot(xa, wq.astype(xa.dtype),
+                    preferred_element_type=jnp.float32)
+        y = (y * sc.astype(jnp.float32)).astype(xa.dtype)
+        if b:
+            y = y + b[0].astype(xa.dtype)
+        return y
+
+    return apply_op(fn, *args)
+
+
+class WeightOnlyLinear(Layer):
+    """Drop-in decode-path replacement for nn.Linear with an int8 weight.
+
+    Int8 weight and scales are registered as buffers (not parameters): a
+    quantized model serves, it does not train.
+    """
+
+    def __init__(self, linear):
+        super().__init__()
+        self.in_features = linear.in_features
+        self.out_features = linear.out_features
+        qw, scales = weight_quantize(linear.weight)
+        self.register_buffer("weight", qw)
+        self.register_buffer("weight_scale", scales)
+        if linear.bias is not None:
+            self.register_buffer("bias", Tensor._wrap(linear.bias._data))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return weight_only_linear(x, self.weight, self.bias,
+                                  self.weight_scale)
+
+    def extra_repr(self):
+        return (f"in_features={self.in_features}, "
+                f"out_features={self.out_features}, int8")
+
+
+def quantize_for_decode(model, include=None, min_features=0):
+    """Swap eligible nn.Linear sublayers for WeightOnlyLinear, in place.
+
+    ``include``: optional predicate ``(qualified_name, layer) -> bool``;
+    default quantizes every Linear whose in_features >= min_features (use
+    min_features to keep small projections and heads in bf16). Returns the
+    model and the number of layers swapped."""
+    from . import Linear
+
+    swapped = 0
+    for name, sub in list(model.named_sublayers(include_self=True)):
+        # children live in _sub_layers (attribute assignment routes Layer
+        # values there too; LayerList/Sequential children are ONLY there)
+        for child_name, child in list(sub._sub_layers.items()):
+            if not isinstance(child, Linear):
+                continue
+            qual = f"{name}.{child_name}" if name else child_name
+            if child.in_features < min_features:
+                continue
+            if include is not None and not include(qual, child):
+                continue
+            setattr(sub, child_name, WeightOnlyLinear(child))
+            swapped += 1
+    return model, swapped

@@ -568,6 +568,14 @@ class PagedCacheState:
     def quantized(self):
         return self.scale_pages is not None
 
+    def positions(self, s):
+        """Per-slot token positions for the next ``s`` tokens:
+        slot b's tokens sit at [lengths[b], lengths[b] + s) — the ONE
+        definition shared by GPT wpe lookup, LLaMA RoPE, and the page
+        writes (ragged-batch position bugs come from re-deriving this)."""
+        return (self.lengths[:, None]
+                + jnp.arange(s, dtype=jnp.int32)[None])
+
     def tree_flatten(self):
         return ((self.k_pages, self.v_pages, self.scale_pages,
                  self.block_tables, self.lengths, self.prefill_valid),
@@ -609,7 +617,7 @@ def paged_state_prefill(state, k, v, real_len):
     page (0), so bucketed/padded prompts are safe. Returns the new state
     with ``lengths += real_len``."""
     b, s0 = k.shape[:2]
-    pos = state.lengths[:, None] + jnp.arange(s0, dtype=jnp.int32)[None]
+    pos = state.positions(s0)
     valid = jnp.arange(s0, dtype=jnp.int32)[None] < real_len[:, None]
     logical = jnp.clip(pos // state.page_size, 0,
                        state.block_tables.shape[1] - 1)

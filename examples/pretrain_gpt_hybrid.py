@@ -81,12 +81,15 @@ def build_layers(hidden, heads, n_layers, vocab):
 
 
 def ce_loss(logits, labels):
-    l = logits._data if isinstance(logits, Tensor) else logits
-    y = labels._data if isinstance(labels, Tensor) else labels
-    l = l.astype(jnp.float32)
-    logz = jax.nn.logsumexp(l, axis=-1)
-    gold = jnp.take_along_axis(l, y[..., None], axis=-1)[..., 0]
-    return Tensor._wrap(jnp.mean(logz - gold))
+    # vocab-parallel CE under mp>1 (no full-vocab logits per rank —
+    # reference: c_softmax_with_cross_entropy); plain CE otherwise
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ParallelCrossEntropy)
+
+    v = logits.shape[-1]
+    per_tok = ParallelCrossEntropy()(
+        logits.reshape([-1, v]), labels.reshape([-1]))
+    return per_tok.mean()
 
 
 def main():

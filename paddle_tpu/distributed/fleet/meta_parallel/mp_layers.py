@@ -149,7 +149,24 @@ class ParallelCrossEntropy(nn.Layer):
         if (mesh is None or "mp" not in mesh.axis_names
                 or mesh.shape["mp"] <= 1 or vocab % mesh.shape["mp"]):
             return None
+        if self._inside_manual_region():
+            # already under a shard_map (e.g. the compiled pipeline's 'pp'
+            # region): a nested shard_map over the original mesh is
+            # rejected by jax — fall back to plain CE and let GSPMD keep
+            # the mp sharding of the logits
+            return None
         return mesh
+
+    @staticmethod
+    def _inside_manual_region() -> bool:
+        try:
+            from jax._src import mesh as _mesh_lib
+
+            cur = _mesh_lib.get_abstract_mesh()
+            return bool(cur is not None and getattr(cur, "axis_types", None)
+                        and any("Manual" in str(t) for t in cur.axis_types))
+        except Exception:
+            return False
 
     def forward(self, input, label):
         from ....framework.tensor import Tensor, apply_op

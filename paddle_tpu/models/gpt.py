@@ -288,8 +288,15 @@ class GPTForCausalLM(GenerationMixin, nn.Layer):
         return self.gpt.init_caches(batch_size, max_seq, dtype)
 
     def loss(self, input_ids, labels):
+        """Mean causal-LM loss. Under an active mp>1 mesh the CE runs the
+        vocab-parallel shard_map kernel (reference:
+        c_softmax_with_cross_entropy, SURVEY A15) so no rank ever
+        materializes full-vocab logits; off-mesh it is plain CE
+        (numerically identical)."""
+        from ..distributed.fleet.meta_parallel import ParallelCrossEntropy
+
         logits = self.forward(input_ids)
         v = logits.shape[-1]
-        return F.cross_entropy(
-            logits.reshape([-1, v]), labels.reshape([-1])
-        )
+        per_tok = ParallelCrossEntropy()(
+            logits.reshape([-1, v]), labels.reshape([-1]))
+        return per_tok.mean()

@@ -261,7 +261,12 @@ class LlamaForCausalLM(GenerationMixin, nn.Layer):
         return self.model.init_caches(batch_size, max_seq, dtype)
 
     def loss(self, input_ids, labels):
+        """Mean causal-LM loss via the vocab-parallel CE when an mp>1 mesh
+        is active (see GPTForCausalLM.loss)."""
+        from ..distributed.fleet.meta_parallel import ParallelCrossEntropy
+
         logits = self.forward(input_ids)
         v = logits.shape[-1]
-        return F.cross_entropy(
+        per_tok = ParallelCrossEntropy()(
             logits.reshape([-1, v]), labels.reshape([-1]))
+        return per_tok.mean()

@@ -1,0 +1,130 @@
+"""Model loss through the vocab-parallel CE (VERDICT r2 #7: the kernel
+reached ParallelCrossEntropy in round 2 but no model used it; reference:
+c_softmax_with_cross_entropy, SURVEY A15)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+VOCAB, H, B, S = 4096, 32, 4, 128
+
+
+def _mesh():
+    devs = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devs, ("dp", "mp"))
+
+
+@pytest.fixture
+def gpt_model():
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=VOCAB, hidden_size=H, num_layers=2,
+                    num_heads=2, max_position=S)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+class TestModelLossVocabParallel:
+    def test_loss_matches_plain_ce_off_mesh(self, gpt_model, rng):
+        """Without an mp mesh, model.loss must equal the old plain CE."""
+        import paddle_tpu.nn.functional as F
+
+        ids = Tensor._wrap(jnp.asarray(rng.integers(0, VOCAB, (2, 16)),
+                                       jnp.int32))
+        labels = Tensor._wrap(jnp.asarray(rng.integers(0, VOCAB, (2, 16)),
+                                          jnp.int32))
+        got = float(np.asarray(gpt_model.loss(ids, labels)))
+        logits = gpt_model(ids)
+        want = float(np.asarray(F.cross_entropy(
+            logits.reshape([-1, VOCAB]), labels.reshape([-1]))))
+        assert got == pytest.approx(want, rel=1e-6)
+
+    def test_mp_loss_equivalence_and_grads(self, gpt_model, rng):
+        """On a dp4 x mp2 mesh, model.loss (vocab-parallel kernel) must match
+        the unsharded loss, and grads must flow."""
+        from paddle_tpu.distributed import parallel as dist_parallel
+        from paddle_tpu.jit import functional_call, param_arrays
+
+        model = gpt_model
+        ids = jnp.asarray(rng.integers(0, VOCAB, (B, S)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, VOCAB, (B, S)), jnp.int32)
+
+        def loss_fn(params, ids, labels):
+            from paddle_tpu.jit import swapped_params
+            from paddle_tpu.framework.tensor import pause_tape
+
+            with swapped_params(model, params), pause_tape():
+                out = model.loss(Tensor._wrap(ids), Tensor._wrap(labels))
+            return out._data if isinstance(out, Tensor) else out
+
+        params = [p._data for _, p in model.named_parameters()]
+        base = float(jax.jit(loss_fn)(params, ids, labels))
+
+        mesh = _mesh()
+        old = dist_parallel._MESH if hasattr(dist_parallel, "_MESH") else None
+        dist_parallel.set_mesh(mesh)
+        try:
+            with mesh:
+                sharded = jax.jit(loss_fn)(params, ids, labels)
+                got = float(jax.device_get(sharded))
+                grads = jax.jit(jax.grad(loss_fn))(params, ids, labels)
+                assert all(np.all(np.isfinite(np.asarray(g))) for g in grads)
+        finally:
+            dist_parallel.set_mesh(old)
+        assert got == pytest.approx(base, rel=2e-4), (got, base)
+
+    def test_mp_step_never_materializes_full_vocab_logits(self, gpt_model,
+                                                          rng):
+        """Compile-time memory assertion (VERDICT r2 #7 done-criterion):
+        with the vocab-parallel CE, the compiled mp train step's per-device
+        temp allocations must stay well below one full-vocab logits tensor
+        — the [B*S, V] f32 tensor (8 MB here) can never exist per rank."""
+        from paddle_tpu.distributed import parallel as dist_parallel
+        from paddle_tpu.jit import swapped_params
+        from paddle_tpu.framework.tensor import pause_tape
+
+        model = gpt_model
+        mesh = _mesh()
+        ids = jnp.asarray(rng.integers(0, VOCAB, (B, S)), jnp.int32)
+        labels = jnp.asarray(rng.integers(0, VOCAB, (B, S)), jnp.int32)
+        params = [p._data for _, p in model.named_parameters()]
+        # shard the tied embedding over vocab (mp) as the TP policy does
+        named = [n for n, _ in model.named_parameters()]
+        params = [
+            jax.device_put(a, NamedSharding(
+                mesh, P("mp", None) if n.endswith("wte.weight") else P()))
+            for n, a in zip(named, params)
+        ]
+
+        def loss_fn(params, ids, labels):
+            with swapped_params(model, params), pause_tape():
+                out = model.loss(Tensor._wrap(ids), Tensor._wrap(labels))
+            return out._data if isinstance(out, Tensor) else out
+
+        old = dist_parallel._MESH if hasattr(dist_parallel, "_MESH") else None
+        dist_parallel.set_mesh(mesh)
+        try:
+            with mesh:
+                lowered = jax.jit(jax.grad(loss_fn)).lower(
+                    params, ids, labels)
+                compiled = lowered.compile()
+                hlo = compiled.as_text()
+        finally:
+            dist_parallel.set_mesh(old)
+        # per-device (post-SPMD) HLO: a full-vocab activation would appear
+        # as a [B*S, V] / [B, S, V] tensor; the mp-sharded program may only
+        # carry V/mp = 2048-wide vocab slices
+        import re
+
+        full = re.findall(
+            rf"f32\[(?:{B * S},{VOCAB}|{B},{S},{VOCAB})\]", hlo)
+        assert not full, (
+            f"{len(full)} full-vocab logits tensors in the per-device HLO")
+        # sanity: the sharded slices DO appear (the vocab really is split)
+        assert re.search(rf"\[(?:{B * S},{VOCAB // 2}|{B},{S},{VOCAB // 2})\]",
+                         hlo)

@@ -1,0 +1,141 @@
+"""Activation-stash (non-remat) 1F1B mode + schedule efficiency proxy
+(VERDICT r2 #5; reference: pipeline_parallel.py forward_backward_pipeline
+stores activations by default, recompute is opt-in)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+from paddle_tpu.distributed.fleet.meta_parallel.pipeline_engine import (
+    pipeline_schedule_stats)
+from paddle_tpu.framework.tensor import Tensor
+
+H, VOCAB, SEQ, PP, M = 16, 41, 8, 4, 4
+
+
+class Embed(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.word = nn.Embedding(VOCAB, H)
+
+    def forward(self, x):
+        return self.word(x)
+
+
+class Block(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(H, H)
+
+    def forward(self, x):
+        import paddle_tpu.nn.functional as F
+
+        return x + F.gelu(self.fc(x))
+
+
+class Head(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.proj = nn.Linear(H, VOCAB)
+
+    def forward(self, x):
+        return self.proj(x)
+
+
+def ce(logits, labels):
+    l = logits._data if isinstance(logits, Tensor) else logits
+    y = labels._data if isinstance(labels, Tensor) else labels
+    logz = jax.nn.logsumexp(l, axis=-1)
+    gold = jnp.take_along_axis(l, y[..., None], axis=-1)[..., 0]
+    return Tensor._wrap(jnp.mean(logz - gold))
+
+
+def _build_engine(recompute):
+    paddle.seed(7)
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "pp_degree": PP,
+                               "mp_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": M,
+                                 "schedule": "1F1B",
+                                 "recompute": recompute}
+    fleet.init(is_collective=True, strategy=strategy)
+    model = PipelineLayer(
+        layers=[LayerDesc(Embed), *[LayerDesc(Block) for _ in range(PP)],
+                LayerDesc(Head)],
+        num_stages=PP, loss_fn=ce)
+    engine = fleet.distributed_model(model)
+    opt = fleet.distributed_optimizer(
+        optimizer.SGD(learning_rate=0.0, parameters=model.parameters()))
+    return engine, opt
+
+
+def _run_steps(engine, opt, n=2):
+    rng = np.random.default_rng(0)
+    losses = []
+    for _ in range(n):
+        x = jnp.asarray(rng.integers(0, VOCAB, (2 * M, SEQ)), jnp.int32)
+        y = jnp.asarray(rng.integers(0, VOCAB, (2 * M, SEQ)), jnp.int32)
+        loss = engine.train_batch([paddle.to_tensor(x), paddle.to_tensor(y)],
+                                  opt)
+        losses.append(float(np.asarray(loss)))
+    return losses
+
+
+class TestStashMode:
+    def test_twin_equivalence_remat_vs_stash(self):
+        """recompute=True (remat 1F1B) and recompute=False (activation
+        stash) must produce the same losses (lr=0 keeps weights fixed so
+        step 2 re-checks on identical weights)."""
+        e1, o1 = _build_engine(recompute=True)
+        l1 = _run_steps(e1, o1)
+        e2, o2 = _build_engine(recompute=False)
+        l2 = _run_steps(e2, o2)
+        np.testing.assert_allclose(l1, l2, rtol=2e-5, atol=2e-5)
+
+    def test_stash_mode_traces_fewer_flops(self):
+        """The efficiency proxy in traced numbers: trip-count-aware matmul
+        FLOPs of the stash step must be measurably below the remat step
+        (the remat forward disappears). XLA's cost_analysis can't do this —
+        it counts scan bodies once and switch branches inconsistently."""
+        from paddle_tpu.profiler.flops import dot_flops_of
+
+        flops = {}
+        for recompute in (True, False):
+            engine, opt = _build_engine(recompute=recompute)
+            _run_steps(engine, opt, n=1)
+            step = next(iter(engine._step_cache.values()))
+            rng = np.random.default_rng(0)
+            x = jnp.asarray(rng.integers(0, VOCAB, (2 * M, SEQ)), jnp.int32)
+            y = jnp.asarray(rng.integers(0, VOCAB, (2 * M, SEQ)), jnp.int32)
+            flops[recompute] = dot_flops_of(
+                step, engine._state, engine._opt_state, x, y,
+                jnp.float32(0.0), jnp.float32(1.0), jnp.float32(1.0))
+        assert flops[False] < flops[True], flops
+        # the remat schedule re-runs every stage forward: expect a
+        # double-digit-percent FLOPs gap on this MLP-heavy toy
+        assert flops[True] / flops[False] > 1.10, flops
+
+    def test_schedule_stats_proxy(self):
+        remat = pipeline_schedule_stats(pp=4, M=8, schedule="1f1b",
+                                        recompute=True)
+        stash = pipeline_schedule_stats(pp=4, M=8, schedule="1f1b",
+                                        recompute=False)
+        gpipe = pipeline_schedule_stats(pp=4, M=8, schedule="gpipe")
+        vpp = pipeline_schedule_stats(pp=4, M=8, vpp=2)
+        # remat FLOPs disappear in stash mode
+        assert remat["remat_extra_fwd_units"] == 8
+        assert stash["remat_extra_fwd_units"] == 0
+        assert remat["relative_flops"] == pytest.approx(4 / 3)
+        assert stash["relative_flops"] == 1.0
+        # stash/gpipe coincide under the lockstep regime
+        assert stash == gpipe
+        # interleaving shrinks the bubble fraction vs plain 1f1b
+        assert vpp["bubble_frac"] < remat["bubble_frac"]
+        # sanity: bubbles in (0, 1)
+        for s in (remat, stash, gpipe, vpp):
+            assert 0.0 < s["bubble_frac"] < 1.0

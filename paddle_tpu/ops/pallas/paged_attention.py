@@ -346,7 +346,10 @@ def _paged_slab_kernel(len_ref, bt_ref, q_ref, kp_ref, vp_ref, sc_ref,
                        quantized):
     b = pl.program_id(0)
     length = len_ref[b]
-    npages = (length + page_size - 1) // page_size
+    # defensive clamp: a length beyond the table capacity (a buggy or
+    # overshooting caller) must not drive OOB block-table reads / DMA
+    # writes past the VMEM scratch window
+    npages = jnp.minimum((length + page_size - 1) // page_size, max_pages)
 
     def issue(j, _):
         pg = bt_ref[b, j]
@@ -397,53 +400,41 @@ def _paged_slab_kernel(len_ref, bt_ref, q_ref, kp_ref, vp_ref, sc_ref,
     mask = mask_ids < length
     khd = kwin.shape[-1]
     h_kv = khd // head_dim
-    hd_q = num_heads * head_dim
     group = num_heads // h_kv
-    # whole-window values, full 128-aligned width: sub-128 lane slices do
-    # not lower on TPU, so per-head selection happens via lane masks and the
-    # cross-head contributions are killed by zeros in the dot operands (the
-    # extra MACs are noise at decode shapes)
-    kw = kwin[...].reshape(seq, khd)
-    vw = vwin[...].reshape(seq, khd)
+    # per-head 64-lane ref slices, exactly like the contiguous _slab_kernel
+    # (measured fast there) — the previous full-lane-width roll/select
+    # scheme multiplied every head against ALL kv lanes, ~h_kv x the MACs,
+    # and was the reason paged decode ran ~2.5x slower than contiguous
     if quantized:
         scw = scwin[...].reshape(seq, 128)
-    qrow = q_ref[0].astype(jnp.float32)  # [8, H*D]
-    qlane = jax.lax.broadcasted_iota(jnp.int32, (_Q_ROWS, hd_q), 1)
-    klane = jax.lax.broadcasted_iota(jnp.int32, (_Q_ROWS, khd), 1)
-    acc = jnp.zeros((_Q_ROWS, hd_q), jnp.float32)
     for h in range(num_heads):
         kh_ix = h // group
-        qsel = jnp.where(qlane // head_dim == h, qrow, 0.0)
-        shift = (kh_ix - h) * head_dim
-        if shift:  # roll-by-0 lowers to a zero-size slice — skip it
-            qsel = jnp.roll(qsel, shift, axis=1)
-        if khd != hd_q:
-            qsel = qsel[:, :khd]
-        s = jax.lax.dot_general(
-            qsel, kw.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [8, seq]
+        lo_q = h * head_dim
+        lo_kv = kh_ix * head_dim
+        qh = q_ref[0, :, lo_q:lo_q + head_dim].astype(jnp.float32)  # [8, D]
+        kh = kwin[:, :, lo_kv:lo_kv + head_dim].reshape(
+            seq, head_dim).astype(jnp.float32)
         if quantized:
-            ksc = scw[:, kh_ix:kh_ix + 1]  # per-token k scale [seq, 1]
-            s = s * jnp.transpose(ksc, (1, 0))
+            # dequantize the K slice in place: [seq, 1] scale broadcast
+            # along lanes (a [seq,1]→[1,seq] transpose of the scale row,
+            # the previous scheme, is a lane↔sublane relayout per head —
+            # measured 2x slowdown of the whole int8 decode step)
+            kh = kh * scw[:, kh_ix:kh_ix + 1]
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [8, seq]
         s = jnp.where(mask, s, NEG_INF)
         m = jnp.max(s, axis=-1, keepdims=True)
         p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m), 0.0)
         l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-37)
+        vh = vwin[:, :, lo_kv:lo_kv + head_dim].reshape(
+            seq, head_dim).astype(jnp.float32)
         if quantized:
-            vsc = scw[:, h_kv + kh_ix:h_kv + kh_ix + 1]
-            p = p * jnp.transpose(vsc, (1, 0))
-        out_full = jax.lax.dot_general(
-            p, vw.astype(jnp.float32), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) / l  # [8, khd]
-        sel = jnp.where(klane // head_dim == kh_ix, out_full, 0.0)
-        if khd != hd_q:
-            # widen to the q-head lane space before repositioning
-            pad = jnp.zeros((_Q_ROWS, hd_q - khd), jnp.float32)
-            sel = jnp.concatenate([sel, pad], axis=1)
-        if shift:
-            sel = jnp.roll(sel, -shift, axis=1)
-        acc = acc + sel
-    o_ref[0] = acc.astype(o_ref.dtype)
+            vh = vh * scw[:, h_kv + kh_ix:h_kv + kh_ix + 1]
+        out = jax.lax.dot_general(
+            p, vh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) / l  # [8, D]
+        o_ref[0, :, lo_q:lo_q + head_dim] = out.astype(o_ref.dtype)
 
 
 def paged_slab_decode_attention(q, k_pages, v_pages, block_tables, lengths,
@@ -572,9 +563,16 @@ class PagedCacheState:
         """Per-slot token positions for the next ``s`` tokens:
         slot b's tokens sit at [lengths[b], lengths[b] + s) — the ONE
         definition shared by GPT wpe lookup, LLaMA RoPE, and the page
-        writes (ragged-batch position bugs come from re-deriving this)."""
-        return (self.lengths[:, None]
-                + jnp.arange(s, dtype=jnp.int32)[None])
+        writes (ragged-batch position bugs come from re-deriving this).
+        Clamped to the table capacity minus one: a chain-overshooting
+        straggler saturates ``lengths`` AT the capacity (== max_position
+        for engine-built tables), and the embedding lookup for its
+        (discarded) garbage tokens must not index past the wpe/rope
+        tables — OOB-gather clamping is not a contract (ADVICE r3)."""
+        cap = self.block_tables.shape[1] * self.page_size
+        pos = (self.lengths[:, None]
+               + jnp.arange(s, dtype=jnp.int32)[None])
+        return jnp.minimum(pos, cap - 1)
 
     def tree_flatten(self):
         return ((self.k_pages, self.v_pages, self.scale_pages,
@@ -649,10 +647,17 @@ def paged_state_step(state, q, k, v, scale=None):
     phys = jnp.where(active, state.block_tables[jnp.arange(b), logical], 0)
     slotpos = jnp.where(active, pos % state.page_size, 0)
     kq, vq, sc = _store_rows(state, k, v)  # [B, KHD]
+    # cap lengths at the table capacity: a chained straggler that keeps
+    # decoding past its budget (engine chain overshoot) must never push
+    # npages past max_pages in the attention kernel — at the cap its
+    # writes recirculate in the last page and its output is garbage the
+    # engine was going to discard anyway
+    cap = state.block_tables.shape[1] * state.page_size
     new = dict(
         k_pages=state.k_pages.at[phys, slotpos].set(kq),
         v_pages=state.v_pages.at[phys, slotpos].set(vq),
-        lengths=state.lengths + active.astype(state.lengths.dtype),
+        lengths=jnp.minimum(
+            state.lengths + active.astype(state.lengths.dtype), cap),
     )
     if state.quantized:
         new["scale_pages"] = state.scale_pages.at[phys, slotpos].set(sc)

@@ -156,13 +156,16 @@ def bench_decode(cfg, on_tpu):
 
     # same prefill + same compiled scan both times (max_seq pinned, scan
     # length bucketed pow2): the long-minus-short difference isolates pure
-    # decode steps, cancelling prefill cost and the tunnel round trip
+    # decode steps, cancelling prefill cost and the tunnel round trip.
+    # The differential is REPEATED and medianed — a single sample rides
+    # the tunnel's RTT jitter, which is how r3 shipped a >100% roofline
+    # fraction (VERDICT r3 weak #1 / next #3).
     short = new // 4
     timed(new)
     timed(short)  # warm both scan lengths
-    dt_long = timed(new)
-    dt_short = timed(short)
-    dt = dt_long - dt_short
+    reps = 3 if on_tpu else 1
+    diffs = sorted(timed(new) - timed(short) for _ in range(reps))
+    dt = diffs[reps // 2]
     steps = new - short
 
     dev = jax.devices()[0]
@@ -190,17 +193,30 @@ def bench_decode(cfg, on_tpu):
     quantize_for_decode(model)
     timed(new)
     timed(short)
-    dt8 = timed(new) - timed(short)
-    ms8 = 1e3 * dt8 / steps
+    diffs8 = sorted(timed(new) - timed(short) for _ in range(reps))
+    ms8 = 1e3 * diffs8[reps // 2] / steps
     # only Linear projections quantize; embeddings (and the tied wte lm
-    # head) still stream bf16 every token
+    # head) still stream bf16 every token. int8 linears also stream one
+    # f32 scale per output column (4 bytes x 9h columns per layer) —
+    # negligible, but counted.
     emb_params = (cfg.vocab_size + cfg.max_position) * cfg.hidden_size
     linear_params = cfg.num_params() - emb_params
-    floor8_s = (linear_params + emb_params * 2 + kv_bytes) / hbm_bw(dev)
+    scale_bytes = 2 * (4 * 4 + 2) * cfg.num_layers * cfg.hidden_size
+    floor8_s = (linear_params + emb_params * 2 + scale_bytes
+                + kv_bytes) / hbm_bw(dev)
     out.update({
         "decode_int8w_ms_per_token": round(ms8, 3),
         "decode_int8w_roofline_frac": round(floor8_s * 1e3 / ms8, 3),
     })
+    # a roofline fraction above 1.0 is physically impossible — it means
+    # the byte model or the timing is wrong; flag loudly rather than ship
+    # a number that erodes trust in the rest (VERDICT r3 #3)
+    for key in ("decode_roofline_frac", "decode_int8w_roofline_frac"):
+        if out[key] > 1.0:
+            print(f"WARNING: {key}={out[key]} exceeds the physical "
+                  "roofline; timing jitter or byte-model error",
+                  file=sys.stderr)
+            out[key + "_suspect"] = True
     return out
 
 
@@ -224,15 +240,21 @@ def main():
     if on_tpu:
         medium = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
                            max_position=1024, vocab_size=50304)
+        medium2k = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                             max_position=2048, vocab_size=50304)
         small = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
                           max_position=1024, vocab_size=50304)
         r_med = bench_train(medium, batch=12, seq=1024, steps=15)
+        # long-seq line (VERDICT r3 #2): tiled packed flash, S=2048 —
+        # fits HBM at b=8 without remat
+        r_2k = bench_train(medium2k, batch=8, seq=2048, steps=10)
         r_small = bench_train(small, batch=8, seq=1024, steps=20)
         decode_cfg = small
     else:  # CPU smoke mode so the script always runs
         tiny = GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
                          max_position=256, vocab_size=1024)
         r_med = bench_train(tiny, batch=2, seq=128, steps=3)
+        r_2k = None
         r_small = r_med
         decode_cfg = tiny
 
@@ -251,6 +273,10 @@ def main():
         "loss": r_med["loss"],
         "gpt2_small_mfu": round(float(r_small["mfu"]), 4),
         "gpt2_small_tokens_per_sec": round(r_small["tokens_per_sec"], 1),
+        **({"s2048_mfu": round(float(r_2k["mfu"]), 4),
+            "s2048_mfu_incl_attn": round(float(r_2k["mfu_incl_attn"]), 4),
+            "s2048_tokens_per_sec": round(r_2k["tokens_per_sec"], 1),
+            "s2048_batch": r_2k["batch"]} if r_2k else {}),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         **decode,
         **paged,

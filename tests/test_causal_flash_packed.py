@@ -43,8 +43,58 @@ class TestPackedKernel:
     def test_supported_predicate(self):
         assert supported(1024, 64)
         assert not supported(1030, 64)  # not multiple of 8
-        assert not supported(2048, 64)  # beyond whole-seq VMEM budget
+        assert supported(2048, 64)      # tiled regime (VERDICT r3 #2)
+        assert supported(8192, 64)
+        assert not supported(2048 + 8, 64)   # tiled needs S % 512 == 0
+        assert not supported(16384, 64)      # beyond tiled VMEM budget
         assert not supported(256, 96)   # head dim not MXU-native
+
+    def test_tiled_long_seq_matches_reference(self, rng):
+        """S=2048 routes to the tiled causal-block-skip kernels (VERDICT
+        r3 #2); fwd and the shared-p triangle backward must match naive
+        attention."""
+        B, H, S, D = 1, 2, 2048, 64
+        qkv = jnp.asarray(rng.standard_normal((B, 3 * H, S, D)) * 0.3,
+                          jnp.float32)
+        out = causal_flash_qkv(qkv, H)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref(qkv, H)), atol=1e-5)
+        ct = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.float32)
+        g1 = jax.grad(lambda x: jnp.sum(causal_flash_qkv(x, H) * ct))(qkv)
+        g2 = jax.grad(lambda x: jnp.sum(_ref(x, H) * ct))(qkv)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=2e-5)
+
+    def test_tiled_pair_packed_long_seq(self, rng):
+        """Pair-packed (hpb=2) layout through the tiled kernels at
+        S=2048: forward + backward vs the per-head reference."""
+        from paddle_tpu.ops.pallas.causal_flash import heads_per_block
+
+        B, H, S, D = 1, 2, 2048, 64
+        assert heads_per_block(H, D) == 2
+        per_head = jnp.asarray(
+            rng.standard_normal((B, 3 * H, S, D)) * 0.3, jnp.float32)
+        paired = per_head.reshape(B, 3 * H // 2, 2, S, D).transpose(
+            0, 1, 3, 2, 4).reshape(B, 3 * H // 2, S, 2 * D)
+        out = causal_flash_qkv(paired, H, D)
+        want = _ref(per_head, H)  # [B, H, S, D]
+        want = want.reshape(B, H // 2, 2, S, D).transpose(
+            0, 1, 3, 2, 4).reshape(B, H // 2, S, 2 * D)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=1e-5)
+        ct = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.float32)
+        g = jax.grad(lambda x: jnp.sum(causal_flash_qkv(x, H, D) * ct))(
+            paired)
+        # reference grad in the paired layout
+        def ref_paired(x):
+            ph = x.reshape(B, 3 * H // 2, S, 2, D).transpose(
+                0, 1, 3, 2, 4).reshape(B, 3 * H, S, D)
+            o = _ref(ph, H)
+            return o.reshape(B, H // 2, 2, S, D).transpose(
+                0, 1, 3, 2, 4).reshape(B, H // 2, S, 2 * D)
+        g2 = jax.grad(lambda x: jnp.sum(ref_paired(x) * ct))(paired)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g2),
+                                   atol=2e-5)
 
     def test_pair_packed_matches_reference(self, rng):
         """hpb=2 lane pairing (D=64, even heads) must equal per-head attn."""

@@ -63,5 +63,6 @@ from .auto_parallel import (  # noqa: F401
     reshard,
     shard_tensor,
 )
+from .auto_parallel_static import Engine  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import communication  # noqa: F401

@@ -191,8 +191,25 @@ class ParallelCrossEntropy(nn.Layer):
             return loss.reshape(shape)
 
         lbl = label if isinstance(label, Tensor) else Tensor(label)
-        return apply_op(fn, input if isinstance(input, Tensor)
-                        else Tensor(input), lbl)
+        try:
+            return apply_op(fn, input if isinstance(input, Tensor)
+                            else Tensor(input), lbl)
+        except Exception as e:
+            # _inside_manual_region probes a private jax API; if that
+            # detection ever drifts (ADVICE r3), the nested shard_map
+            # fails at trace time — degrade to plain CE (GSPMD keeps the
+            # logits' mp sharding) rather than breaking the loss path.
+            # Warn loudly: this branch also catches genuine bugs, and a
+            # silent implementation switch would bury them.
+            import warnings
+
+            warnings.warn(
+                "ParallelCrossEntropy fell back to plain cross_entropy "
+                f"after {type(e).__name__}: {e}", RuntimeWarning,
+                stacklevel=2)
+            return F.cross_entropy(
+                input, label, reduction="none",
+                ignore_index=self.ignore_index)
 
 
 def parallel_cross_entropy_shardmap(logits_shard, labels, axis_name="mp"):

@@ -15,6 +15,19 @@ semantics:
 * agents watch the epoch; on change they stop the local world and relaunch
   with the new assignment, resuming from checkpoints (the reference's
   documented recovery model — no in-memory state migration).
+
+**Single-instance semantics (divergence from the reference's ETCDMaster):**
+etcd replicates membership across a quorum; this master is ONE process. If
+it dies, agents keep running their current world (heartbeats fail
+transiently and are retried), but no scale events can happen until a master
+is back. With ``state_path`` set, the master journals its membership epoch
+and node table to disk on every change and REHYDRATES from that file on
+construction: a restarted master resumes epoch numbering monotonically
+(agents would mis-read a reset epoch counter as "no change") and re-admits
+the previous nodes, which must confirm liveness via heartbeat within
+``ttl`` or be reaped exactly like a scale-in. Run the master under a
+supervisor (systemd/k8s) for availability; quorum replication is out of
+scope by design (SURVEY C18).
 """
 from __future__ import annotations
 
@@ -32,15 +45,19 @@ class ElasticMaster:
     """Threaded rendezvous/membership service."""
 
     def __init__(self, port: int = 0, min_nodes: int = 1,
-                 max_nodes: Optional[int] = None, ttl: float = 10.0):
+                 max_nodes: Optional[int] = None, ttl: float = 10.0,
+                 state_path: Optional[str] = None):
         self.min_nodes = min_nodes
         self.max_nodes = max_nodes or max(min_nodes, 1 << 20)
         self.ttl = ttl
+        self.state_path = state_path
         self._mu = threading.Lock()
         self._nodes: Dict[str, dict] = {}  # node_id -> {endpoint, last_seen}
         self._epoch = 0
         self._assignment: Dict[str, int] = {}
         self._world: List[str] = []
+        if state_path:
+            self._rehydrate()
 
         master = self
 
@@ -93,11 +110,49 @@ class ElasticMaster:
     # ------------------------------------------------------------- handlers
     def _reassign_locked(self):
         """Freeze membership into a new epoch (sorted by endpoint for
-        determinism)."""
+        determinism); journal it when persistence is on."""
         eps = sorted((i["endpoint"], nid) for nid, i in self._nodes.items())
         self._world = [e for e, _ in eps]
         self._assignment = {nid: r for r, (_, nid) in enumerate(eps)}
         self._epoch += 1
+        if self.state_path:
+            self._persist_locked()
+
+    def _persist_locked(self):
+        """Write epoch + node table atomically (tmp + rename)."""
+        import os
+
+        state = {"epoch": self._epoch,
+                 "nodes": {nid: i["endpoint"]
+                           for nid, i in self._nodes.items()}}
+        tmp = f"{self.state_path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, self.state_path)
+
+    def _rehydrate(self):
+        """Resume from a journaled epoch after a master restart: epoch
+        numbering stays monotonic and previous members are re-admitted
+        with a fresh lease — they either confirm via heartbeat within
+        ``ttl`` or get reaped like an ordinary scale-in."""
+        import os
+
+        if not os.path.exists(self.state_path):
+            return
+        try:
+            with open(self.state_path) as f:
+                state = json.load(f)
+        except (OSError, ValueError):
+            return  # corrupt/partial journal: start fresh
+        self._epoch = int(state.get("epoch", 0))
+        now = time.monotonic()
+        for nid, endpoint in state.get("nodes", {}).items():
+            self._nodes[nid] = {"endpoint": endpoint, "last_seen": now}
+        if self._nodes:
+            eps = sorted((i["endpoint"], nid)
+                         for nid, i in self._nodes.items())
+            self._world = [e for e, _ in eps]
+            self._assignment = {nid: r for r, (_, nid) in enumerate(eps)}
 
     def _register(self, req):
         nid, endpoint = req["node_id"], req["endpoint"]

@@ -101,6 +101,12 @@ class Engine:
         self.page_size = page_size
         self.chunk_size = chunk_size
         self.max_chain = max(1, int(max_chain))
+        if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
+            # fail here, not as an opaque trace-time lax.top_k error at
+            # the first sampled request (code-review r4)
+            raise ValueError(
+                f"top_k={top_k} must be in [1, vocab_size="
+                f"{cfg.vocab_size}]")
         self.top_k = top_k
         self.eos_id = eos_id
         self.quantized = bool(quantized_cache)

@@ -104,6 +104,22 @@ def _tree_unflatten(tree, arrs):
 # ----------------------------------------------------------- worker process
 
 
+def _unlink_segment(name):
+    """Best-effort unlink of a shared-memory segment a dead worker can no
+    longer reclaim (the attach/close pair balances the resource_tracker
+    registration the attach performs)."""
+    from multiprocessing import shared_memory
+
+    try:
+        seg = shared_memory.SharedMemory(name=name)
+        seg.unlink()
+        seg.close()
+    except FileNotFoundError:
+        pass
+    except Exception:
+        pass
+
+
 def _process_worker(conn, dataset, collate_fn, worker_init_fn, wid, use_shm):
     """Child entry: serve ("task", i, idxs) requests until ("stop",).
 
@@ -326,8 +342,12 @@ class _ProcessPool:
                     _, ep, i, name, metas, tree = msg
                     if ep != epoch:
                         # stale result from an abandoned epoch: ack so the
-                        # worker unlinks the segment, drop the payload
-                        self._send(wid, ("ack", name))
+                        # worker unlinks the segment, drop the payload —
+                        # and if the worker is already gone, the unlink
+                        # falls to us (ADVICE r3: a dead worker's
+                        # published segment otherwise leaks /dev/shm)
+                        if not self._send(wid, ("ack", name)):
+                            _unlink_segment(name)
                         continue
                     # NOTE: attach re-registers the name with the (shared,
                     # spawn-inherited) resource_tracker, whose cache is a
@@ -341,9 +361,12 @@ class _ProcessPool:
                                 offset=off))
                             for shape, dt, off in metas
                         ]
+                        if not self._send(wid, ("ack", name)):
+                            # worker died after publishing: it can never
+                            # unlink — we own the segment's lifetime now
+                            seg.unlink()
                     finally:
                         seg.close()
-                    self._send(wid, ("ack", name))
                     results[i] = _tree_unflatten(tree, arrs)
                 else:
                     _, ep, i, payload = msg
@@ -374,6 +397,19 @@ class _ProcessPool:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
+        # drain undelivered results: a terminated worker never sees the ack
+        # for segments it already published, so unlink them here (ADVICE
+        # r3 — otherwise each pending segment leaks /dev/shm space until
+        # interpreter exit)
+        for c in self.conns:
+            try:
+                while c.poll(0):
+                    msg = c.recv()
+                    if (isinstance(msg, tuple) and msg
+                            and msg[0] == "shm"):
+                        _unlink_segment(msg[3])
+            except Exception:
+                pass
         for c in self.conns:
             try:
                 c.close()

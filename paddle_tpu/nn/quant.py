@@ -26,34 +26,65 @@ def _t(x):
 
 
 def weight_quantize(x, algo="weight_only_int8"):
-    """Per-output-channel symmetric int8 quantization of a [in, out] weight.
-    Returns ``(int8 weight [in, out], f32 scales [out])``."""
-    if algo != "weight_only_int8":
-        raise NotImplementedError(
-            f"weight_quantize: only 'weight_only_int8' is supported "
-            f"(got {algo!r}); int4 is a recorded gap")
+    """Per-output-channel symmetric quantization of a [in, out] weight.
+
+    * ``weight_only_int8`` → ``(int8 weight [in, out], f32 scales [out])``
+    * ``weight_only_int4`` → ``(int8 weight [in/2, out], f32 scales
+      [out])`` — two nibbles packed per byte (rows 2k at the low nibble,
+      2k+1 at the high nibble), range [-7, 7], so the weight stream is a
+      QUARTER of bf16 (VERDICT r3 #9; reference:
+      paddle.nn.quant.weight_quantize int4 path).
+    """
     w = _t(x)._data
     amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=0)
-    scales = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scales[None, :]),
-                 -127, 127).astype(jnp.int8)
-    return Tensor._wrap(q), Tensor._wrap(scales)
+    if algo == "weight_only_int8":
+        scales = jnp.maximum(amax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scales[None, :]),
+                     -127, 127).astype(jnp.int8)
+        return Tensor._wrap(q), Tensor._wrap(scales)
+    if algo == "weight_only_int4":
+        if w.shape[0] % 2:
+            raise ValueError("weight_only_int4 needs even in_features "
+                             f"(got {w.shape[0]})")
+        scales = jnp.maximum(amax, 1e-8) / 7.0
+        q = jnp.clip(jnp.round(w.astype(jnp.float32) / scales[None, :]),
+                     -7, 7).astype(jnp.int8)
+        packed = jnp.bitwise_or(
+            jnp.bitwise_and(q[0::2], jnp.int8(0x0F)),
+            jnp.left_shift(q[1::2], 4))
+        return Tensor._wrap(packed), Tensor._wrap(scales)
+    raise NotImplementedError(
+        f"weight_quantize: unsupported algo {algo!r}")
 
 
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8"):
-    """y = x @ dequant(W) + b with int8-stored W (reference:
-    paddle.nn.quant.weight_only_linear)."""
-    if weight_dtype != "int8":
-        raise NotImplementedError("weight_only_linear: int8 only")
+    """y = x @ dequant(W) + b with int8- or int4-stored W (reference:
+    paddle.nn.quant.weight_only_linear).
+
+    int4 runs as TWO dots — even input columns against the sign-extended
+    low nibbles, odd columns against the high nibbles — so the nibble
+    shifts stay elementwise unary chains XLA fuses into the dot operand
+    loads (an unpack-to-[in,out] would materialize a full-width weight
+    and forfeit the bandwidth win)."""
+    if weight_dtype not in ("int8", "int4"):
+        raise NotImplementedError("weight_only_linear: int8/int4 only")
     args = [_t(x), _t(weight), _t(weight_scale)]
     has_bias = bias is not None
     if has_bias:
         args.append(_t(bias))
 
     def fn(xa, wq, sc, *b):
-        y = jnp.dot(xa, wq.astype(xa.dtype),
-                    preferred_element_type=jnp.float32)
+        if weight_dtype == "int4":
+            lo = jnp.right_shift(jnp.left_shift(wq, 4), 4).astype(xa.dtype)
+            hi = jnp.right_shift(wq, 4).astype(xa.dtype)
+            y = (jnp.dot(xa[..., 0::2], lo,
+                         preferred_element_type=jnp.float32)
+                 + jnp.dot(xa[..., 1::2], hi,
+                           preferred_element_type=jnp.float32))
+        else:
+            y = jnp.dot(xa, wq.astype(xa.dtype),
+                        preferred_element_type=jnp.float32)
         y = (y * sc.astype(jnp.float32)).astype(xa.dtype)
         if b:
             y = y + b[0].astype(xa.dtype)
@@ -63,17 +94,19 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
 
 
 class WeightOnlyLinear(Layer):
-    """Drop-in decode-path replacement for nn.Linear with an int8 weight.
+    """Drop-in decode-path replacement for nn.Linear with an int8 or
+    packed-int4 weight.
 
-    Int8 weight and scales are registered as buffers (not parameters): a
-    quantized model serves, it does not train.
+    Quantized weight and scales are registered as buffers (not
+    parameters): a quantized model serves, it does not train.
     """
 
-    def __init__(self, linear):
+    def __init__(self, linear, algo="weight_only_int8"):
         super().__init__()
         self.in_features = linear.in_features
         self.out_features = linear.out_features
-        qw, scales = weight_quantize(linear.weight)
+        self.weight_dtype = "int4" if algo == "weight_only_int4" else "int8"
+        qw, scales = weight_quantize(linear.weight, algo=algo)
         self.register_buffer("weight", qw)
         self.register_buffer("weight_scale", scales)
         if linear.bias is not None:
@@ -83,20 +116,24 @@ class WeightOnlyLinear(Layer):
 
     def forward(self, x):
         return weight_only_linear(x, self.weight, self.bias,
-                                  self.weight_scale)
+                                  self.weight_scale,
+                                  weight_dtype=self.weight_dtype)
 
     def extra_repr(self):
         return (f"in_features={self.in_features}, "
-                f"out_features={self.out_features}, int8")
+                f"out_features={self.out_features}, {self.weight_dtype}")
 
 
-def quantize_for_decode(model, include=None, min_features=0):
+def quantize_for_decode(model, include=None, min_features=0,
+                        algo="weight_only_int8"):
     """Swap eligible nn.Linear sublayers for WeightOnlyLinear, in place.
 
     ``include``: optional predicate ``(qualified_name, layer) -> bool``;
     default quantizes every Linear whose in_features >= min_features (use
-    min_features to keep small projections and heads in bf16). Returns the
-    model and the number of layers swapped."""
+    min_features to keep small projections and heads in bf16). ``algo``:
+    ``weight_only_int8`` or ``weight_only_int4`` (int4 skips odd
+    in_features layers, which cannot nibble-pack). Returns the model and
+    the number of layers swapped."""
     from . import Linear
 
     swapped = 0
@@ -109,8 +146,10 @@ def quantize_for_decode(model, include=None, min_features=0):
             qual = f"{name}.{child_name}" if name else child_name
             if child.in_features < min_features:
                 continue
+            if algo == "weight_only_int4" and child.in_features % 2:
+                continue
             if include is not None and not include(qual, child):
                 continue
-            setattr(sub, child_name, WeightOnlyLinear(child))
+            setattr(sub, child_name, WeightOnlyLinear(child, algo=algo))
             swapped += 1
     return model, swapped

@@ -1,0 +1,95 @@
+"""Static auto-parallel Engine facade (VERDICT r3 #7; reference:
+python/paddle/distributed/auto_parallel/static/engine.py). Twin-checks the
+pjit-lowered Engine.fit against the dynamic eager tape path, and runs a
+config-5-style sharded-weight model through fit/evaluate/predict on the
+virtual 8-device mesh."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed import Engine, ProcessMesh, Replicate, Shard, shard_tensor
+from paddle_tpu.framework.tensor import Tensor
+
+
+def _mlp(seed=0):
+    paddle.seed(seed)
+    return nn.Sequential(
+        nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 10))
+
+
+def _data(n_batches=4, bs=8, seed=1):
+    rng = np.random.default_rng(seed)
+    return [(rng.standard_normal((bs, 16)).astype(np.float32),
+             rng.integers(0, 10, (bs,)).astype(np.int64))
+            for _ in range(n_batches)]
+
+
+class TestEngineTwin:
+    def test_fit_matches_dynamic_eager(self):
+        """Engine.fit (pjit over the mesh) must reproduce the dynamic
+        eager-tape training losses and final weights."""
+        data = _data()
+        # dynamic path
+        m1 = _mlp()
+        loss1 = nn.CrossEntropyLoss()
+        opt1 = optimizer.SGD(learning_rate=0.1,
+                             parameters=m1.parameters())
+        dyn_losses = []
+        for x, y in data:
+            out = m1(Tensor(x))
+            l = loss1(out, Tensor(y))
+            dyn_losses.append(float(np.asarray(l)))
+            l.backward()
+            opt1.step()
+            opt1.clear_grad()
+        # static engine path
+        m2 = _mlp()
+        eng = Engine(m2, loss=nn.CrossEntropyLoss(),
+                     optimizer=optimizer.SGD(learning_rate=0.1,
+                                             parameters=m2.parameters()))
+        hist = eng.fit(data, epochs=1)
+        np.testing.assert_allclose(hist, dyn_losses, rtol=1e-5, atol=1e-6)
+        for (n1, p1), (n2, p2) in zip(m1.named_parameters(),
+                                      m2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                       rtol=1e-5, atol=1e-6,
+                                       err_msg=f"{n1} vs {n2}")
+
+    def test_fit_evaluate_predict_on_sharded_mesh(self):
+        """Config-5 style: mp-sharded weights via shard_tensor + dp-sharded
+        batches, through the full fit/evaluate/predict surface."""
+        ndev = len(jax.devices())
+        if ndev < 4:
+            pytest.skip("needs the 8-device virtual mesh")
+        mesh = ProcessMesh(
+            np.arange(ndev).reshape(ndev // 2, 2), ("dp", "mp"))
+        model = _mlp(seed=2)
+        # shard the hidden layer's weight over mp (column parallel style)
+        model[0].weight = type(model[0].weight)(
+            shard_tensor(model[0].weight, mesh,
+                         [Replicate(), Shard(1)])._data)
+        eng = Engine(model, loss=nn.CrossEntropyLoss(),
+                     optimizer=optimizer.Adam(
+                         learning_rate=1e-2,
+                         parameters=model.parameters()),
+                     mesh=mesh)
+        data = _data(n_batches=6, bs=8, seed=3)
+        hist = eng.fit(data, epochs=2)
+        assert len(hist) == 12
+        assert hist[-1] < hist[0], "loss should decrease on a fixed batch set"
+        res = eng.evaluate(data)
+        assert res["loss"] == pytest.approx(
+            np.mean(hist[-1:]), rel=1.0)  # sanity: finite, same scale
+        preds = eng.predict([x for x, _ in data])
+        assert len(preds) == 6 and preds[0].shape == (8, 10)
+        # trained weights visible to the dynamic view after fit
+        w = np.asarray(model[0].weight)
+        assert np.all(np.isfinite(w))
+
+    def test_fit_requires_loss_and_optimizer(self):
+        eng = Engine(_mlp())
+        with pytest.raises(ValueError, match="loss and optimizer"):
+            eng.fit(_data(1))

@@ -33,9 +33,44 @@ class TestWeightQuant:
         np.testing.assert_allclose(got, want, atol=0.05, rtol=0.02)
 
     def test_unsupported_algo_raises(self, rng):
-        with pytest.raises(NotImplementedError, match="int4 is a recorded"):
+        with pytest.raises(NotImplementedError, match="unsupported algo"):
             weight_quantize(paddle.to_tensor(np.ones((4, 4), np.float32)),
-                            algo="weight_only_int4")
+                            algo="weight_only_int2")
+
+    def test_int4_roundtrip_and_packing(self, rng):
+        """int4 path (VERDICT r3 #9): nibble-packed storage is half the
+        int8 bytes; dequant error bounded by scale/2 per element."""
+        w = rng.standard_normal((64, 96)).astype(np.float32) * 0.3
+        qw, sc = weight_quantize(paddle.to_tensor(w),
+                                 algo="weight_only_int4")
+        assert np.asarray(qw).shape == (32, 96)  # two rows per byte
+        assert np.asarray(qw).dtype == np.int8
+        packed = np.asarray(qw).astype(np.int8)
+        lo = ((packed.astype(np.int32) << 28) >> 28)  # sign-extended nibble
+        hi = (packed.astype(np.int32) >> 4)
+        deq = np.empty_like(w)
+        deq[0::2] = lo * np.asarray(sc)[None, :]
+        deq[1::2] = hi * np.asarray(sc)[None, :]
+        assert np.max(np.abs(deq - w)) <= np.max(np.asarray(sc)) * 0.51
+
+    def test_int4_linear_matches_fp(self, rng):
+        x = rng.standard_normal((4, 64)).astype(np.float32)
+        w = rng.standard_normal((64, 96)).astype(np.float32) * 0.2
+        b = rng.standard_normal((96,)).astype(np.float32)
+        qw, sc = weight_quantize(paddle.to_tensor(w),
+                                 algo="weight_only_int4")
+        got = np.asarray(weight_only_linear(
+            paddle.to_tensor(x), qw, paddle.to_tensor(b), sc,
+            weight_dtype="int4"))
+        want = x @ w + b
+        # int4: ~16x coarser than int8 — tolerance scales accordingly
+        np.testing.assert_allclose(got, want, atol=0.6, rtol=0.1)
+
+    def test_int4_odd_in_features_raises(self, rng):
+        with pytest.raises(ValueError, match="even in_features"):
+            weight_quantize(
+                paddle.to_tensor(np.ones((5, 4), np.float32)),
+                algo="weight_only_int4")
 
 
 class TestQuantizedModel:
@@ -77,3 +112,31 @@ class TestQuantizedModel:
         r = eng.add_request(rng.integers(0, 97, (8,)), 6)
         eng.run()
         assert r.done and len(r.tokens) == 6
+
+    def test_int4_generate_close_and_composes_with_int8_cache(self, rng):
+        """int4 weights + int8 KV pages through the Engine (VERDICT r3
+        #9's composition requirement): serving completes and mostly
+        agrees with the fp32 path at tiny-model scale."""
+        from paddle_tpu.inference.engine import Engine
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        paddle.seed(0)
+        cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                        max_position=128, vocab_size=97)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        p = rng.integers(0, 97, (9,))
+        want = np.asarray(model.generate(
+            Tensor._wrap(jnp.asarray(p[None])), max_new_tokens=8,
+            temperature=0.0))[0, 9:]
+        _, n = quantize_for_decode(model, algo="weight_only_int4")
+        assert n == 2 * 4
+        assert model.gpt.h[0].attn.qkv_proj.weight_dtype == "int4"
+        eng = Engine(model, max_slots=2, num_pages=48, page_size=8,
+                     chunk_size=4, dtype=jnp.float32, quantized_cache=True)
+        r = eng.add_request(p, 8)
+        eng.run()
+        assert r.done and len(r.tokens) == 8
+        # int4 rounding flips more ties than int8 — ask for weak agreement
+        agree = sum(int(a == b) for a, b in zip(r.tokens, want.tolist()))
+        assert agree >= 3, (r.tokens, want)

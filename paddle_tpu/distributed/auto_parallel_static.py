@@ -182,6 +182,13 @@ class Engine:
             steps_per_epoch=None, verbose=0, log_freq=10):
         if self.loss is None or self.optimizer is None:
             raise ValueError("Engine.fit needs loss and optimizer")
+        from ..io import Dataset
+
+        if (epochs > 1 and not isinstance(train_data, (Dataset, list,
+                                                       tuple))):
+            # a one-shot iterable (generator) would silently train only
+            # epoch 1 — materialize it so every epoch sees the batches
+            train_data = list(train_data)
         self._ensure_params()
         step_fn = self._build_fit()
         with self.mesh:

@@ -135,6 +135,10 @@ class ParallelCrossEntropy(nn.Layer):
     materializes full-vocab logits per rank, round-1 verdict weak #7);
     otherwise plain CE, which under pure GSPMD is numerically identical."""
 
+    # incremented whenever the shard_map path errored and plain CE was
+    # substituted — tests assert this stays 0 on the mp path
+    fallback_count = 0
+
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
@@ -199,10 +203,13 @@ class ParallelCrossEntropy(nn.Layer):
             # detection ever drifts (ADVICE r3), the nested shard_map
             # fails at trace time — degrade to plain CE (GSPMD keeps the
             # logits' mp sharding) rather than breaking the loss path.
-            # Warn loudly: this branch also catches genuine bugs, and a
-            # silent implementation switch would bury them.
+            # Warn loudly AND count: plain CE is numerically identical, so
+            # without the counter a permanent silent fallback would pass
+            # every correctness test while losing the no-full-vocab-logits
+            # property (tests assert the counter stays zero).
             import warnings
 
+            ParallelCrossEntropy.fallback_count += 1
             warnings.warn(
                 "ParallelCrossEntropy fell back to plain cross_entropy "
                 f"after {type(e).__name__}: {e}", RuntimeWarning,

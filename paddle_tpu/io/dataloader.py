@@ -397,19 +397,24 @@ class _ProcessPool:
             p.join(timeout=5)
             if p.is_alive():
                 p.terminate()
-        # drain undelivered results: a terminated worker never sees the ack
-        # for segments it already published, so unlink them here (ADVICE
-        # r3 — otherwise each pending segment leaks /dev/shm space until
-        # interpreter exit)
-        for c in self.conns:
-            try:
-                while c.poll(0):
-                    msg = c.recv()
-                    if (isinstance(msg, tuple) and msg
-                            and msg[0] == "shm"):
-                        _unlink_segment(msg[3])
-            except Exception:
-                pass
+                p.join(timeout=2)
+        # The puller threads OWN the connections (recv is not thread-safe
+        # to share); once the workers are gone their ends close, the
+        # pullers hit EOF, enqueue DEAD and exit — wait for that, then
+        # drain out_q for undelivered shm results: a terminated worker
+        # never sees the ack for segments it already published, so the
+        # unlink falls to us (ADVICE r3 — otherwise each pending segment
+        # leaks /dev/shm space until interpreter exit)
+        for t in getattr(self, "_pullers", ()):
+            t.join(timeout=2)
+        try:
+            while True:
+                _, msg = self.out_q.get_nowait()
+                if (isinstance(msg, tuple) and msg
+                        and msg[0] == "shm"):
+                    _unlink_segment(msg[3])
+        except queue.Empty:
+            pass
         for c in self.conns:
             try:
                 c.close()

@@ -5,23 +5,32 @@ devices in one process — the cheap analogue of the reference's subprocess
 spawn harness (test/legacy_test/test_dist_base.py) for mesh/sharding logic.
 Must be set before jax initializes its backends, hence in conftest at import
 time.
+
+The CPU pin is SCOPED to this virtual-mesh suite (VERDICT r3 #4): the
+on-chip lane (``make onchip`` → ``tests/onchip/`` with
+``PADDLE_TPU_ONCHIP=1``) keeps the real TPU backend so Pallas kernels run
+through Mosaic rather than interpret mode.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_ONCHIP = os.environ.get("PADDLE_TPU_ONCHIP") == "1"
+
+if not _ONCHIP:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
 
 import jax  # noqa: E402
 
-# The hosted-TPU plugin in this image registers itself regardless of
-# JAX_PLATFORMS in the environment; the in-process config update is what
-# actually pins the test run to the virtual CPU devices.
-jax.config.update("jax_platforms", "cpu")
+if not _ONCHIP:
+    # The hosted-TPU plugin in this image registers itself regardless of
+    # JAX_PLATFORMS in the environment; the in-process config update is
+    # what actually pins the test run to the virtual CPU devices.
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,33 @@
+"""On-chip test lane (VERDICT r3 #4 / r2 #8): runs the Pallas kernels
+NON-interpret through Mosaic on the real TPU, plus the PJRT memory tests
+that need a physical device.
+
+Entry: ``make onchip`` (or ``PADDLE_TPU_ONCHIP=1 python -m pytest
+tests/onchip -q``). The parent conftest's CPU pin is scoped off by the
+env flag; this conftest then refuses to run unless a TPU is actually
+present, so a mis-invocation can't silently "pass" in interpret mode.
+Done-criterion: skip count 0 in the on-chip log.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+
+def pytest_collection_modifyitems(config, items):
+    if os.environ.get("PADDLE_TPU_ONCHIP") != "1":
+        skip = pytest.mark.skip(
+            reason="on-chip lane: set PADDLE_TPU_ONCHIP=1 (make onchip)")
+        for it in items:
+            it.add_marker(skip)
+        return
+    if jax.default_backend() != "tpu":
+        pytest.exit("PADDLE_TPU_ONCHIP=1 but no TPU backend is available",
+                    returncode=3)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

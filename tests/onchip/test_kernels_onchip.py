@@ -1,0 +1,193 @@
+"""Non-interpret (Mosaic-lowered) equivalence for every Pallas kernel
+family (VERDICT r3 #4: a Mosaic-only lowering bug must surface as a test
+failure, not a wrong bench number). Each test compares the real-TPU kernel
+against its jnp reference twin at serving/train-representative shapes.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _err(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32)
+                                 - b.astype(jnp.float32))))
+
+
+class TestCausalFlashOnChip:
+    def test_whole_seq_fwd_bwd(self, rng):
+        from paddle_tpu.ops.pallas import causal_flash as cf
+
+        B, H, D, S = 2, 4, 64, 512
+        qkv = jnp.asarray(rng.standard_normal((B, 6, S, 128)) * 0.3,
+                          jnp.bfloat16)
+        out, lse = cf._fwd(qkv, H, D, 1 / 8.0)
+        # interpret-mode twin is the exact reference
+        assert not cf._interpret()
+        ref_out, ref_lse = jax.jit(
+            lambda x: cf._fwd(x.astype(jnp.float32), H, D, 1 / 8.0))(qkv)
+        assert _err(out, ref_out) < 2e-2
+        g = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.bfloat16)
+        d = cf._bwd(H, D, 1 / 8.0, (qkv, out, lse), g)
+        d2 = cf._bwd_tiled(H, D, 1 / 8.0, (qkv, out, lse), g)
+        rel = _err(d, d2) / (float(jnp.max(jnp.abs(
+            d.astype(jnp.float32)))) + 1e-9)
+        assert rel < 3e-2, rel
+
+    def test_tiled_long_seq(self, rng):
+        from paddle_tpu.ops.pallas.causal_flash import causal_flash_qkv
+
+        B, H, D, S = 1, 2, 64, 2048
+        qkv = jnp.asarray(rng.standard_normal((B, 3, S, 128)) * 0.3,
+                          jnp.bfloat16)
+        out = causal_flash_qkv(qkv, H, D)
+        # reference in f32 on the same chip (plain XLA ops, no Pallas)
+        x = qkv.astype(jnp.float32).reshape(B, 3, 1, S, 2, D)
+        q, k, v = x[:, 0], x[:, 1], x[:, 2]
+        logits = jnp.einsum("bgshd,bgthd->bghst", q, k) / np.sqrt(D)
+        mask = np.tril(np.ones((S, S), bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        want = jnp.einsum("bghst,bgthd->bgshd",
+                          jax.nn.softmax(logits, -1), v)
+        want = want.reshape(B, 1, S, 2 * D)
+        assert _err(out, want) < 2e-2
+
+    def test_tiled_grad_matches_ref_grad(self, rng):
+        from paddle_tpu.ops.pallas.causal_flash import causal_flash_qkv
+
+        B, H, D, S = 1, 2, 64, 2048
+        qkv = jnp.asarray(rng.standard_normal((B, 3, S, 128)) * 0.3,
+                          jnp.float32)
+
+        def ref(x):
+            xr = x.reshape(B, 3, 1, S, 2, D)
+            q, k, v = xr[:, 0], xr[:, 1], xr[:, 2]
+            logits = jnp.einsum("bgshd,bgthd->bghst", q, k) / np.sqrt(D)
+            mask = np.tril(np.ones((S, S), bool))
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            o = jnp.einsum("bghst,bgthd->bgshd",
+                           jax.nn.softmax(logits, -1), v)
+            return o.reshape(B, 1, S, 2 * D)
+
+        ct = jnp.asarray(rng.standard_normal((B, 1, S, 128)) * 0.1,
+                         jnp.float32)
+        g1 = jax.grad(lambda x: jnp.sum(causal_flash_qkv(x, H, D) * ct))(
+            qkv)
+        g2 = jax.grad(lambda x: jnp.sum(ref(x) * ct))(qkv)
+        rel = _err(g1, g2) / (float(jnp.max(jnp.abs(g2))) + 1e-9)
+        assert rel < 1e-2, rel
+
+
+class TestGeneralFlashOnChip:
+    def test_fused_fwd_bwd(self, rng):
+        from paddle_tpu.ops.pallas.flash_attention import (
+            flash_attention_fused)
+
+        B, S, H, D = 2, 512, 4, 64
+        q = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.3,
+                        jnp.bfloat16)
+        k = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.3,
+                        jnp.bfloat16)
+        v = jnp.asarray(rng.standard_normal((B, S, H, D)) * 0.3,
+                        jnp.bfloat16)
+        out = flash_attention_fused(q, k, v, causal=True)
+
+        def ref(q, k, v):
+            qf = q.astype(jnp.float32)
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+            s = s / np.sqrt(D)
+            mask = np.tril(np.ones((S, S), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1),
+                              v.astype(jnp.float32))
+
+        assert _err(out, ref(q, k, v)) < 2e-2
+        ct = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.bfloat16)
+        g1 = jax.grad(lambda a: jnp.sum((flash_attention_fused(
+            a, k, v, causal=True) * ct).astype(jnp.float32)))(q)
+        g2 = jax.grad(lambda a: jnp.sum(ref(a, k, v) * ct))(q)
+        rel = _err(g1, g2) / (float(jnp.max(jnp.abs(
+            g2.astype(jnp.float32)))) + 1e-9)
+        assert rel < 5e-2, rel
+
+
+class TestDecodeOnChip:
+    def test_decode_attention_pallas(self, rng):
+        from paddle_tpu.ops.pallas.decode_attention import (
+            decode_attention_pallas, decode_attention_ref)
+
+        B, H, D, S = 8, 12, 64, 1024
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+        kc = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+        vc = jnp.asarray(rng.standard_normal((B, H, S, D)), jnp.bfloat16)
+        lengths = jnp.asarray(rng.integers(1, S, (B,)), jnp.int32)
+        got = decode_attention_pallas(q, kc, vc, lengths)
+        want = decode_attention_ref(q, kc, vc, lengths)
+        assert _err(got, want) < 2e-2
+
+    def test_slab_decode(self, rng):
+        from paddle_tpu.ops.pallas.decode_attention import (
+            _slab_pallas, _slab_ref)
+
+        B, H, D, S = 8, 12, 64, 640
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+        slab = jnp.asarray(rng.standard_normal((2, B, S, H * D)),
+                           jnp.bfloat16)
+        lengths = jnp.asarray(rng.integers(1, S, (B,)), jnp.int32)
+        got = _slab_pallas(q, slab, lengths, 1 / 8.0)
+        want = _slab_ref(q, slab, lengths, 1 / 8.0)
+        assert _err(got, want) < 2e-2
+
+
+class TestPagedOnChip:
+    def _tables(self, rng, B, NP, PS, MAXP):
+        bt = np.zeros((B, MAXP), np.int32)
+        lengths = rng.integers(1, MAXP * PS, (B,)).astype(np.int32)
+        used = set()
+        for b in range(B):
+            for j in range(-(-int(lengths[b]) // PS)):
+                pg = int(rng.integers(1, NP))
+                while pg in used:
+                    pg = int(rng.integers(1, NP))
+                used.add(pg)
+                bt[b, j] = pg
+        return jnp.asarray(bt), jnp.asarray(lengths)
+
+    @pytest.mark.parametrize("hkv", [12, 4])
+    def test_slab_paged_bf16(self, rng, hkv):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            _paged_slab_ref, paged_slab_decode_attention)
+
+        B, H, D, PS, NP, MAXP = 8, 12, 64, 16, 120, 24
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+        kp = jnp.asarray(rng.standard_normal((NP, PS, hkv * D)),
+                         jnp.bfloat16)
+        vp = jnp.asarray(rng.standard_normal((NP, PS, hkv * D)),
+                         jnp.bfloat16)
+        bt, lengths = self._tables(rng, B, NP, PS, MAXP)
+        got = paged_slab_decode_attention(q, kp, vp, bt, lengths, H)
+        want = _paged_slab_ref(q, kp, vp, bt, lengths, 1 / 8.0)
+        assert _err(got, want) < 5e-2
+
+    def test_slab_paged_int8(self, rng):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            _paged_slab_ref, paged_slab_decode_attention,
+            quantize_rows_int8)
+
+        B, H, D, HKV, PS, NP, MAXP = 8, 12, 64, 4, 16, 120, 24
+        q = jnp.asarray(rng.standard_normal((B, H, D)), jnp.bfloat16)
+        kq, ks = quantize_rows_int8(jnp.asarray(
+            rng.standard_normal((NP, PS, HKV, D)), jnp.float32))
+        vq, vs = quantize_rows_int8(jnp.asarray(
+            rng.standard_normal((NP, PS, HKV, D)), jnp.float32))
+        sc = (jnp.zeros((NP, PS, 128), jnp.bfloat16)
+              .at[..., :HKV].set(ks.astype(jnp.bfloat16))
+              .at[..., HKV:2 * HKV].set(vs.astype(jnp.bfloat16)))
+        kq = kq.reshape(NP, PS, HKV * D)
+        vq = vq.reshape(NP, PS, HKV * D)
+        bt, lengths = self._tables(rng, B, NP, PS, MAXP)
+        got = paged_slab_decode_attention(q, kq, vq, bt, lengths, H,
+                                          scale_pages=sc)
+        want = _paged_slab_ref(q, kq, vq, bt, lengths, 1 / 8.0,
+                               scale_pages=sc)
+        assert _err(got, want) < 5e-2

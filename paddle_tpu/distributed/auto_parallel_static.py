@@ -182,12 +182,15 @@ class Engine:
             steps_per_epoch=None, verbose=0, log_freq=10):
         if self.loss is None or self.optimizer is None:
             raise ValueError("Engine.fit needs loss and optimizer")
-        from ..io import Dataset
-
-        if (epochs > 1 and not isinstance(train_data, (Dataset, list,
-                                                       tuple))):
-            # a one-shot iterable (generator) would silently train only
-            # epoch 1 — materialize it so every epoch sees the batches
+        # a ONE-SHOT iterator (iter(x) is x — e.g. a generator) would
+        # silently train only epoch 1; materialize just that case. Proper
+        # iterables (lists, Datasets, DataLoaders) re-iterate per epoch
+        # and must NOT be slurped into host memory.
+        try:
+            one_shot = iter(train_data) is train_data
+        except TypeError:
+            one_shot = False
+        if epochs > 1 and one_shot:
             train_data = list(train_data)
         self._ensure_params()
         step_fn = self._build_fit()

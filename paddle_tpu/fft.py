@@ -10,7 +10,8 @@ from .framework.tensor import Tensor, apply_op
 __all__ = [
     "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
     "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
-    "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
 ]
 
 
@@ -49,6 +50,58 @@ fftn = _wrapn(jnp.fft.fftn)
 ifftn = _wrapn(jnp.fft.ifftn)
 rfftn = _wrapn(jnp.fft.rfftn)
 irfftn = _wrapn(jnp.fft.irfftn)
+
+
+def _hfft_nd(x, s, axes, norm, inverse):
+    """paddle.fft.hfft2/hfftn family (jnp.fft has only the 1-D hfft):
+    full c2c transforms over the leading axes, Hermitian transform on the
+    last — the reference's decomposition."""
+    axes = tuple(axes)
+    lead, last = axes[:-1], axes[-1]
+    s_lead = None if s is None else tuple(s)[:-1]
+    n_last = None if s is None else tuple(s)[-1]
+    if inverse:
+        # ihfft consumes the REAL input on the last axis first; the
+        # complex ifft over the leading axes follows
+        x = jnp.fft.ihfft(x, n=n_last, axis=last, norm=norm)
+        if lead:
+            x = jnp.fft.ifftn(x, s=s_lead, axes=lead, norm=norm)
+        return x
+    if lead:
+        x = jnp.fft.fftn(x, s=s_lead, axes=lead, norm=norm)
+    return jnp.fft.hfft(x, n=n_last, axis=last, norm=norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda a: _hfft_nd(a, s, axes, norm, False), x)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return apply_op(lambda a: _hfft_nd(a, s, axes, norm, True), x)
+
+
+def _default_axes(a, s, axes):
+    if axes is not None:
+        return tuple(axes)
+    if s is not None:
+        # fftn-family convention: with s given, transform the LAST len(s)
+        # axes
+        return tuple(range(a.ndim - len(s), a.ndim))
+    return tuple(range(a.ndim))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    def fn(a):
+        return _hfft_nd(a, s, _default_axes(a, s, axes), norm, False)
+
+    return apply_op(fn, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def fn(a):
+        return _hfft_nd(a, s, _default_axes(a, s, axes), norm, True)
+
+    return apply_op(fn, x)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

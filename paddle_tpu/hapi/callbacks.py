@@ -191,9 +191,12 @@ class VisualDL(Callback):
 
         os.makedirs(self.log_dir, exist_ok=True)
         try:
-            from torch.utils.tensorboard import SummaryWriter
+            # native TensorBoard-format writer (utils/tbevents.py) — r3
+            # review flagged torch.utils.tensorboard, a competing
+            # framework, as an odd primary backend for this callback
+            from ..utils.tbevents import EventFileWriter
 
-            self._writer = SummaryWriter(log_dir=self.log_dir)
+            self._writer = EventFileWriter(self.log_dir)
         except Exception:
             self._jsonl = open(
                 os.path.join(self.log_dir, "scalars.jsonl"), "a")

@@ -1,0 +1,551 @@
+"""Tensor-API long tail, tranche 2 (VERDICT r3 #5 — the two-round-old
+breadth debt; reference: python/paddle/tensor/{math,manipulation,linalg,
+random,attribute,einsum}.py and python/paddle/framework).
+
+Same contract as ``longtail.py``: accept Tensors or array-likes, route
+through ``apply_op`` so eager autograd records VJPs, trace cleanly under
+jit. Groups:
+
+* elementwise/special math (acosh...multigammaln) — jnp/jax.scipy.special;
+* top-level linalg aliases (paddle historically re-exports most of
+  paddle.linalg at the root: ``paddle.cholesky``, ``paddle.svd``, ...);
+* attribute/introspection (is_tensor, numel, rank, shape, finfo, ...);
+* random tail (binomial, standard_gamma, log_normal, randint_like);
+* in-place variants (``paddle.sqrt_``, ``paddle.clip_``, ...): the
+  underlying arrays are immutable jax values, so "in place" means the
+  TENSOR's storage is replaced (``set_value``) and the same Tensor object
+  returns — the reference's aliasing semantics at the API surface (an
+  x64-honesty-note-level divergence: no view aliasing underneath);
+* manipulation stragglers (as_strided, view, shard_index, ...).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    # elementwise / special
+    "acosh", "asinh", "atanh", "atan2", "deg2rad", "rad2deg", "expm1",
+    "logit", "sgn", "erfc", "gammaln", "gammainc", "gammaincc",
+    "multigammaln", "positive", "isposinf", "isneginf", "mod",
+    "floor_mod",
+    # linalg top-level aliases
+    "cholesky", "cholesky_solve", "cond", "det", "dist", "eig", "eigh",
+    "eigvals", "eigvalsh", "inverse", "lstsq", "lu", "lu_unpack",
+    "matrix_power", "matrix_rank", "multi_dot", "pinv", "qr", "slogdet",
+    "solve", "svd", "t", "triangular_solve",
+    # attributes / introspection / framework
+    "is_tensor", "is_complex", "is_floating_point", "is_integer",
+    "is_empty", "numel", "rank", "shape", "broadcast_shape", "tolist",
+    "finfo", "iinfo", "set_printoptions", "set_grad_enabled",
+    "get_rng_state", "set_rng_state", "create_parameter", "complex",
+    # random tail
+    "binomial", "standard_gamma", "log_normal", "randint_like",
+    # manipulation stragglers
+    "as_strided", "view", "view_as", "shard_index", "add_n",
+    "clip_by_norm", "diagonal_scatter",
+    # in-place variants (generated below)
+    "abs_", "acos_", "acosh_", "add_", "asin_", "asinh_", "atan_",
+    "atanh_", "ceil_", "clip_", "copysign_", "cos_", "cosh_", "divide_",
+    "exp_", "expm1_", "fill_", "fill_diagonal_", "flatten_",
+    "floor_", "floor_divide_", "gcd_", "hypot_", "index_fill_",
+    "index_put_", "lcm_", "lerp_", "log_", "log10_", "log1p_", "log2_",
+    "masked_fill_", "masked_scatter_", "multiply_", "nan_to_num_",
+    "neg_", "pow_", "put_along_axis_", "reciprocal_", "remainder_",
+    "renorm_", "reshape_", "round_", "rsqrt_", "scale_", "scatter_",
+    "sin_", "sinh_", "sqrt_", "square_", "squeeze_", "subtract_",
+    "tan_", "tanh_", "tril_", "triu_", "trunc_", "uniform_",
+    "unsqueeze_", "zero_", "erfinv_", "index_add_", "exponential_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _u(fn, x, **kw):
+    return apply_op(lambda a: fn(a, **kw), _t(x))
+
+
+def _b(fn, x, y):
+    return apply_op(fn, _t(x), _t(y))
+
+
+# ------------------------------------------------- elementwise / special
+
+
+def acosh(x):
+    return _u(jnp.arccosh, x)
+
+
+def asinh(x):
+    return _u(jnp.arcsinh, x)
+
+
+def atanh(x):
+    return _u(jnp.arctanh, x)
+
+
+def atan2(x, y):
+    return _b(jnp.arctan2, x, y)
+
+
+def deg2rad(x):
+    return _u(jnp.deg2rad, x)
+
+
+def rad2deg(x):
+    return _u(jnp.rad2deg, x)
+
+
+def expm1(x):
+    return _u(jnp.expm1, x)
+
+
+def logit(x, eps=None):
+    def fn(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+
+    return apply_op(fn, _t(x))
+
+
+def sgn(x):
+    # real: sign; complex: x/|x| (0 for 0) — jnp.sign implements both
+    return _u(jnp.sign, x)
+
+
+def erfc(x):
+    from jax.scipy.special import erfc as f
+
+    return _u(f, x)
+
+
+def gammaln(x):
+    from jax.scipy.special import gammaln as f
+
+    return _u(f, x)
+
+
+def gammainc(x, y):
+    from jax.scipy.special import gammainc as f
+
+    return _b(f, x, y)
+
+
+def gammaincc(x, y):
+    from jax.scipy.special import gammaincc as f
+
+    return _b(f, x, y)
+
+
+def multigammaln(x, p):
+    from jax.scipy.special import multigammaln as f
+
+    return apply_op(lambda a: f(a, int(p)), _t(x))
+
+
+def positive(x):
+    return apply_op(lambda a: +a, _t(x))
+
+
+def isposinf(x):
+    return _u(jnp.isposinf, x)
+
+
+def isneginf(x):
+    return _u(jnp.isneginf, x)
+
+
+def mod(x, y):
+    """paddle.mod == paddle.remainder (python-style sign)."""
+    return _b(jnp.remainder, x, y)
+
+
+floor_mod = mod
+
+
+# ------------------------------------------------ linalg top-level aliases
+# paddle re-exports most of paddle.linalg at the root; same here, sourced
+# from the one implementation in ops/linalg.py.
+
+from .linalg import (  # noqa: E402
+    cholesky, cholesky_solve, cond, det, dist, eig, eigh, eigvals,
+    eigvalsh, lstsq, lu, lu_unpack, matrix_power, matrix_rank, pinv, qr,
+    slogdet, solve, svd, t, triangular_solve,
+)
+from .linalg import inv as _inv  # noqa: E402
+
+
+def inverse(x):
+    """paddle.inverse (root-level name for linalg.inv)."""
+    return _inv(x)
+
+
+def multi_dot(tensors):
+    """Chained matmul with np-style optimal association order."""
+    arrs = [(_t(a)) for a in tensors]
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(list(xs)), *arrs)
+
+
+# ------------------------------------- attributes / introspection / misc
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.integer)
+
+
+def is_empty(x):
+    return Tensor._wrap(jnp.asarray(_t(x)._data.size == 0))
+
+
+def numel(x):
+    # int32 result: x64 is disabled framework-wide (honesty note — the
+    # reference returns int64)
+    return Tensor._wrap(jnp.asarray(_t(x)._data.size, jnp.int32))
+
+
+def rank(x):
+    return Tensor._wrap(jnp.asarray(_t(x)._data.ndim, jnp.int32))
+
+
+def shape(x):
+    """paddle.shape returns the shape AS A TENSOR (static under jit)."""
+    return Tensor._wrap(jnp.asarray(_t(x)._data.shape, jnp.int32))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def tolist(x):
+    return np.asarray(_t(x)._data).tolist()
+
+
+def finfo(dtype):
+    from ..framework import dtypes
+
+    return np.finfo(np.dtype(dtypes.convert_dtype(dtype)))
+
+
+def iinfo(dtype):
+    from ..framework import dtypes
+
+    return np.iinfo(np.dtype(dtypes.convert_dtype(dtype)))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class set_grad_enabled:
+    """Context manager mirroring paddle.set_grad_enabled(bool)."""
+
+    def __init__(self, mode: bool):
+        self.mode = bool(mode)
+        self._cm = None
+
+    def __enter__(self):
+        from ..framework.tensor import enable_grad, no_grad
+
+        self._cm = enable_grad() if self.mode else no_grad()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+
+def get_rng_state():
+    """Snapshot of the global generator (seed, counter) — paddle returns
+    opaque GeneratorState objects; ours is a picklable tuple."""
+    return (_random.get_seed(), _random._state["counter"])
+
+
+def set_rng_state(state):
+    s, c = state
+    _random.seed(int(s))
+    with _random._lock:
+        _random._state["counter"] = int(c)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter — a trainable Parameter; default init
+    follows the reference (XavierNormal for weights, zeros for bias)."""
+    from ..framework import dtypes
+    from ..framework.tensor import Parameter
+
+    dt = dtypes.convert_dtype(dtype)
+    if default_initializer is not None:
+        data = default_initializer(shape)
+        data = data._data if isinstance(data, Tensor) else jnp.asarray(data)
+    elif is_bias:
+        data = jnp.zeros(shape, dt)
+    else:
+        fan_in = shape[0] if shape else 1
+        fan_out = shape[-1] if len(shape) > 1 else 1
+        std = float(np.sqrt(2.0 / (fan_in + fan_out)))
+        data = std * jax.random.normal(_random.next_key(), tuple(shape), dt)
+    return Parameter(data)
+
+
+def complex(real, imag):
+    return apply_op(jax.lax.complex, _t(real), _t(imag))
+
+
+# ------------------------------------------------------------ random tail
+
+
+def binomial(count, prob):
+    """Binomial(count, prob) samples. jax.random has no binomial; sample
+    host-side with numpy seeded from the global generator state (eager
+    only, like the reference's CPU kernel for this op)."""
+    c = np.asarray(_t(count)._data)
+    p = np.asarray(_t(prob)._data)
+    with _random._lock:
+        host_seed = (_random.get_seed() * 1000003
+                     + _random._state["counter"]) & 0x7FFFFFFF
+        _random._state["counter"] += 1
+    out = np.random.default_rng(host_seed).binomial(c, p)
+    return Tensor._wrap(jnp.asarray(out, jnp.int32))
+
+
+def standard_gamma(x):
+    a = _t(x)._data
+    return Tensor._wrap(jax.random.gamma(_random.next_key(),
+                                         a.astype(jnp.float32)))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None):
+    n = jax.random.normal(_random.next_key(),
+                          tuple(shape) if shape else (1,))
+    return Tensor._wrap(jnp.exp(mean + std * n))
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    arr = _t(x)._data
+    if high is None:
+        low, high = 0, low
+    from ..framework import dtypes
+
+    dt = (np.dtype(dtypes.convert_dtype(dtype)) if dtype is not None
+          else arr.dtype)
+    out = jax.random.randint(_random.next_key(), arr.shape, low, high)
+    return Tensor._wrap(out.astype(dt))
+
+
+# ------------------------------------------------ manipulation stragglers
+
+
+def as_strided(x, shape, stride, offset=0):
+    """np.as_strided semantics over a flat view. XLA has no aliasing, so
+    this MATERIALIZES the gathered result (honesty note: a write-through
+    view is impossible on immutable arrays)."""
+    def fn(a):
+        flat = a.reshape(-1)
+        idx = jnp.asarray(offset)
+        for n, s in zip(shape, stride):
+            idx = idx[..., None] + jnp.arange(n) * s
+        return flat[idx.reshape(-1)].reshape(tuple(shape))
+
+    return apply_op(fn, _t(x))
+
+
+def view(x, shape_or_dtype):
+    """Reshape view, or bitcast reinterpret when given a dtype (paddle's
+    dual-role paddle.view)."""
+    from ..framework import dtypes
+
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return apply_op(
+            lambda a: a.reshape(tuple(shape_or_dtype)), _t(x))
+    dt = np.dtype(dtypes.convert_dtype(shape_or_dtype))
+
+    def fn(a):
+        old = a.dtype.itemsize
+        new = dt.itemsize
+        if old == new:
+            return jax.lax.bitcast_convert_type(a, dt)
+        lead, last = a.shape[:-1], a.shape[-1]
+        if (last * old) % new:
+            raise ValueError("view(dtype): trailing bytes not divisible")
+        if old < new:
+            # widening: jax requires the minor dim to equal new//old —
+            # group that many elements before the bitcast
+            ratio = new // old
+            out = jax.lax.bitcast_convert_type(
+                a.reshape(lead + (last // ratio, ratio)), dt)
+            return out.reshape(lead + (last // ratio,))
+        # narrowing: the bitcast appends an (old//new)-wide axis — fold it
+        out = jax.lax.bitcast_convert_type(a, dt)
+        return out.reshape(lead + (last * old // new,))
+
+    return apply_op(fn, _t(x))
+
+
+def view_as(x, other):
+    return apply_op(lambda a, b: a.reshape(b.shape), _t(x), _t(other))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Map global label ids to shard-local ids (reference:
+    paddle.shard_index for sharded softmax labels)."""
+    if not 0 <= shard_id < nshards:
+        raise ValueError(f"shard_id {shard_id} out of range [0, {nshards})")
+    size = (index_num + nshards - 1) // nshards
+    lo = shard_id * size
+
+    def fn(a):
+        in_shard = (a >= lo) & (a < lo + size)
+        return jnp.where(in_shard, a - lo, ignore_value)
+
+    return apply_op(fn, _t(input))
+
+
+def add_n(inputs):
+    arrs = [_t(a) for a in (inputs if isinstance(inputs, (list, tuple))
+                            else [inputs])]
+    return apply_op(lambda *xs: sum(xs[1:], xs[0]), *arrs)
+
+
+def clip_by_norm(x, max_norm):
+    def fn(a):
+        n = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+        scale = jnp.minimum(1.0, max_norm / jnp.maximum(n, 1e-12))
+        return (a.astype(jnp.float32) * scale).astype(a.dtype)
+
+    return apply_op(fn, _t(x))
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    def fn(a, b):
+        n1, n2 = a.shape[axis1], a.shape[axis2]
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset >= 0 else 0)
+        # move the two axes to front for a clean scatter
+        a2 = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        b2 = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+        a2 = a2.at[i, j].set(b2)
+        return jnp.moveaxis(a2, (0, 1), (axis1, axis2))
+
+    return apply_op(fn, _t(x), _t(y))
+
+
+# ------------------------------------------------------ in-place variants
+# "In place" replaces the Tensor's storage and returns the same Tensor
+# (reference: python/paddle/tensor/inplace-variant registration). Built
+# from the pure ops so the two can never drift.
+
+
+def _make_inplace(pure_fn):
+    def fn_(x, *args, **kwargs):
+        out = pure_fn(x, *args, **kwargs)
+        x.set_value(out)
+        return x
+
+    fn_.__name__ = pure_fn.__name__ + "_"
+    fn_.__doc__ = f"In-place variant of ``{pure_fn.__name__}``."
+    return fn_
+
+
+def _register_inplace():
+    from . import creation as _creation
+    from . import longtail as _lt
+    from . import manipulation as _manip
+    from . import math as _math
+
+    here = globals()
+
+    def find(name):
+        if name in here and callable(here[name]):
+            return here[name]
+        for mod in (_math, _manip, _lt, _creation):
+            f = getattr(mod, name, None)
+            if f is not None:
+                return f
+        raise AttributeError(name)
+
+    names = [
+        "abs", "acos", "acosh", "add", "asin", "asinh", "atan", "atanh",
+        "ceil", "clip", "copysign", "cos", "cosh", "divide", "exp",
+        "expm1", "flatten", "floor", "floor_divide", "gcd", "hypot",
+        "index_fill", "index_put", "lcm", "lerp", "log", "log10",
+        "log1p", "log2", "masked_fill", "masked_scatter", "multiply",
+        "nan_to_num", "neg", "pow", "put_along_axis", "reciprocal",
+        "remainder", "renorm", "reshape", "round", "rsqrt", "scale",
+        "scatter", "sin", "sinh", "sqrt", "square", "squeeze",
+        "subtract", "tan", "tanh", "tril", "triu", "trunc", "unsqueeze",
+        "erfinv", "index_add",
+    ]
+    for n in names:
+        here[n + "_"] = _make_inplace(find(n))
+
+
+def fill_(x, value):
+    x.set_value(Tensor._wrap(jnp.full_like(_t(x)._data, value)))
+    return x
+
+
+def zero_(x):
+    return fill_(x, 0)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False):
+    def fn(a):
+        n1, n2 = a.shape[-2], a.shape[-1]
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset >= 0 else 0)
+        return a.at[..., i, j].set(value)
+
+    x.set_value(apply_op(fn, _t(x)))
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0):
+    arr = _t(x)._data
+    x.set_value(Tensor._wrap(jax.random.uniform(
+        _random.next_key(), arr.shape, arr.dtype, minval=min, maxval=max)))
+    return x
+
+
+def exponential_(x, lam=1.0):
+    """Fill with Exponential(lam) samples (paddle.Tensor.exponential_)."""
+    arr = _t(x)._data
+    u = jax.random.uniform(_random.next_key(), arr.shape, jnp.float32,
+                           minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)
+    x.set_value(Tensor._wrap((-jnp.log(u) / lam).astype(arr.dtype)))
+    return x
+
+
+_register_inplace()

@@ -1,0 +1,130 @@
+"""Native TensorBoard event-file writer — no torch, no tensorboard pip.
+
+Reference capability: the VisualDL scalar logging backend
+(python/paddle/hapi/callbacks.py VisualDL; VisualDL itself stores its own
+format, but the ecosystem-standard consumer is TensorBoard). Round 3
+review flagged depending on ``torch.utils.tensorboard`` — a competing
+framework — as the primary backend of this callback; the wire formats
+involved are simple enough to emit directly:
+
+* **TFRecord framing**: ``uint64 length | masked crc32c(length) |
+  payload | masked crc32c(payload)`` per record;
+* **Event protobuf** (tensorflow/core/util/event.proto), scalar subset:
+  ``wall_time (1, double) | step (2, int64) | file_version (3, string) |
+  summary (5, Summary{ repeated Value{ tag (1), simple_value (2) } })``.
+
+Files written here open in stock TensorBoard.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+__all__ = ["EventFileWriter"]
+
+# ---------------------------------------------------------------- crc32c
+_CRC_TABLE = []
+
+
+def _crc_table():
+    global _CRC_TABLE
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78  # Castagnoli, reflected
+    table = []
+    for i in range(256):
+        crc = i
+        for _ in range(8):
+            crc = (crc >> 1) ^ (poly if crc & 1 else 0)
+        table.append(crc)
+    _CRC_TABLE = table
+    return table
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# ------------------------------------------------------- protobuf encoding
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_varint(num: int, value: int) -> bytes:
+    return _varint(num << 3) + _varint(value)
+
+
+def _field_double(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 1) + struct.pack("<d", value)
+
+
+def _field_float(num: int, value: float) -> bytes:
+    return _varint((num << 3) | 5) + struct.pack("<f", value)
+
+
+def _field_bytes(num: int, payload: bytes) -> bytes:
+    return _varint((num << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _scalar_event(tag: str, value: float, step: int,
+                  wall_time: float) -> bytes:
+    val = _field_bytes(1, tag.encode()) + _field_float(2, float(value))
+    summary = _field_bytes(1, val)
+    return (_field_double(1, wall_time)
+            + _field_varint(2, int(step))
+            + _field_bytes(5, summary))
+
+
+def _version_event(wall_time: float) -> bytes:
+    return (_field_double(1, wall_time)
+            + _field_bytes(3, b"brain.Event:2"))
+
+
+# ---------------------------------------------------------------- writer
+
+
+class EventFileWriter:
+    """Minimal ``SummaryWriter``-alike: ``add_scalar`` + ``close``."""
+
+    def __init__(self, log_dir: str):
+        os.makedirs(log_dir, exist_ok=True)
+        now = time.time()
+        name = f"events.out.tfevents.{int(now)}.{os.uname().nodename}"
+        self._f = open(os.path.join(log_dir, name), "ab")
+        self._record(_version_event(now))
+
+    def _record(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+        self._f.flush()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._record(_scalar_event(tag, value, step, time.time()))
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None

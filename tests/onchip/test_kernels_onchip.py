@@ -15,24 +15,43 @@ def _err(a, b):
 
 
 class TestCausalFlashOnChip:
+    @staticmethod
+    def _ref(qkv, H, D):
+        """Plain-XLA attention reference — independent of every Pallas
+        code path, so a Mosaic lowering bug can't hide in both sides."""
+        B, G, S, lanes = qkv.shape
+        hpb = lanes // D
+        x = qkv.astype(jnp.float32).reshape(B, 3, G // 3, S, hpb, D)
+        q, k, v = x[:, 0], x[:, 1], x[:, 2]
+        logits = jnp.einsum("bgshd,bgthd->bghst", q, k) / np.sqrt(D)
+        mask = np.tril(np.ones((S, S), bool))
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        o = jnp.einsum("bghst,bgthd->bgshd",
+                       jax.nn.softmax(logits, -1), v)
+        return o.reshape(B, G // 3, S, lanes)
+
     def test_whole_seq_fwd_bwd(self, rng):
         from paddle_tpu.ops.pallas import causal_flash as cf
 
         B, H, D, S = 2, 4, 64, 512
         qkv = jnp.asarray(rng.standard_normal((B, 6, S, 128)) * 0.3,
                           jnp.bfloat16)
-        out, lse = cf._fwd(qkv, H, D, 1 / 8.0)
-        # interpret-mode twin is the exact reference
         assert not cf._interpret()
-        ref_out, ref_lse = jax.jit(
-            lambda x: cf._fwd(x.astype(jnp.float32), H, D, 1 / 8.0))(qkv)
-        assert _err(out, ref_out) < 2e-2
+        out, lse = cf._fwd(qkv, H, D, 1 / 8.0)
+        assert _err(out, self._ref(qkv, H, D)) < 2e-2
         g = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.bfloat16)
         d = cf._bwd(H, D, 1 / 8.0, (qkv, out, lse), g)
+        # independent reference grad via jax AD of the plain-XLA math
+        dref = jax.grad(lambda x: jnp.sum(
+            self._ref(x, H, D) * g.astype(jnp.float32)))(qkv)
+        rel = _err(d, dref) / (float(jnp.max(jnp.abs(
+            dref.astype(jnp.float32)))) + 1e-9)
+        assert rel < 5e-2, rel
+        # tiled bwd against the same independent reference
         d2 = cf._bwd_tiled(H, D, 1 / 8.0, (qkv, out, lse), g)
-        rel = _err(d, d2) / (float(jnp.max(jnp.abs(
-            d.astype(jnp.float32)))) + 1e-9)
-        assert rel < 3e-2, rel
+        rel2 = _err(d2, dref) / (float(jnp.max(jnp.abs(
+            dref.astype(jnp.float32)))) + 1e-9)
+        assert rel2 < 5e-2, rel2
 
     def test_tiled_long_seq(self, rng):
         from paddle_tpu.ops.pallas.causal_flash import causal_flash_qkv

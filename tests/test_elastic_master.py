@@ -155,6 +155,24 @@ class TestVisualDLCallback:
         cb.on_train_end()
         files = os.listdir(tmp_path)
         assert files, "no summary files written"
-        # tensorboard event file or the jsonl fallback
+        # native TensorBoard event file (utils/tbevents.py) or the jsonl
+        # fallback
         assert any(f.startswith("events.") or f == "scalars.jsonl"
                    for f in files), files
+        ev_files = [f for f in files if f.startswith("events.")]
+        if ev_files:
+            # the file must parse with the REAL tensorboard reader and
+            # carry the right values (modern TB migrates simple_value
+            # into tensor.float_val)
+            tb = pytest.importorskip(
+                "tensorboard.backend.event_processing.event_file_loader")
+            got = {}
+            for e in tb.EventFileLoader(
+                    str(tmp_path / ev_files[0])).Load():
+                for v in e.summary.value:
+                    val = (v.tensor.float_val[0] if v.tensor.float_val
+                           else v.simple_value)
+                    got[(v.tag, e.step)] = val
+            assert got[("train/loss", 1)] == pytest.approx(1.25)
+            assert got[("train/loss", 2)] == pytest.approx(1.0)
+            assert got[("eval/acc", 2)] == pytest.approx(0.5)

@@ -1,0 +1,448 @@
+"""OpTest-style numpy-reference checks for the tensor-API long tail,
+tranche 2 (VERDICT r3 #5; reference harness: test/legacy_test/op_test.py).
+Every name in ops/longtail2.__all__ is either checked against a numpy
+reference here or exercised for its documented contract (in-place ops:
+same-object return + storage replacement)."""
+import numpy as np
+import pytest
+import scipy.special as sps
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.tensor import Tensor
+from paddle_tpu.ops import longtail2
+
+
+def t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+def n(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+@pytest.fixture
+def a35(rng):
+    return rng.standard_normal((3, 5)).astype(np.float32)
+
+
+@pytest.fixture
+def spd4(rng):
+    a = rng.standard_normal((4, 4)).astype(np.float32)
+    return a @ a.T + 4 * np.eye(4, dtype=np.float32)
+
+
+class TestElementwiseSpecial:
+    def test_inverse_trig_hyper(self, rng):
+        x = rng.uniform(1.5, 3.0, (6,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.acosh(t(x))), np.arccosh(x),
+                                   rtol=1e-5)
+        y = rng.uniform(-2, 2, (6,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.asinh(t(y))), np.arcsinh(y),
+                                   rtol=1e-5)
+        z = rng.uniform(-0.9, 0.9, (6,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.atanh(t(z))), np.arctanh(z),
+                                   rtol=1e-5)
+
+    def test_atan2_deg_rad(self, rng):
+        x = rng.standard_normal((5,)).astype(np.float32)
+        y = rng.standard_normal((5,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.atan2(t(x), t(y))),
+                                   np.arctan2(x, y), rtol=1e-5)
+        np.testing.assert_allclose(n(paddle.deg2rad(t(x))),
+                                   np.deg2rad(x), rtol=1e-6)
+        np.testing.assert_allclose(n(paddle.rad2deg(t(x))),
+                                   np.rad2deg(x), rtol=1e-6)
+
+    def test_expm1_logit_sgn(self, rng):
+        x = rng.standard_normal((5,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.expm1(t(x))), np.expm1(x),
+                                   rtol=1e-5)
+        p = rng.uniform(0.05, 0.95, (5,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.logit(t(p))),
+                                   np.log(p / (1 - p)), rtol=1e-4)
+        np.testing.assert_allclose(n(paddle.logit(t(p), eps=0.2)),
+                                   np.log(np.clip(p, 0.2, 0.8)
+                                          / (1 - np.clip(p, 0.2, 0.8))),
+                                   rtol=1e-4)
+        c = (rng.standard_normal(4) + 1j * rng.standard_normal(4)).astype(
+            np.complex64)
+        got = n(paddle.sgn(t(c)))
+        np.testing.assert_allclose(got, c / np.abs(c), rtol=1e-5)
+
+    def test_special_functions(self, rng):
+        x = rng.uniform(0.5, 4.0, (6,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.erfc(t(x))), sps.erfc(x),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(n(paddle.gammaln(t(x))),
+                                   sps.gammaln(x), rtol=1e-4)
+        a = rng.uniform(1.0, 3.0, (6,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.gammainc(t(a), t(x))),
+                                   sps.gammainc(a, x), rtol=1e-3)
+        np.testing.assert_allclose(n(paddle.gammaincc(t(a), t(x))),
+                                   sps.gammaincc(a, x), rtol=1e-3)
+        np.testing.assert_allclose(n(paddle.multigammaln(t(x + 2), 2)),
+                                   sps.multigammaln(x + 2, 2), rtol=1e-4)
+
+    def test_positive_inf_predicates_mod(self, rng):
+        x = np.array([1.0, -np.inf, np.inf, np.nan], np.float32)
+        np.testing.assert_array_equal(n(paddle.isposinf(t(x))),
+                                      np.isposinf(x))
+        np.testing.assert_array_equal(n(paddle.isneginf(t(x))),
+                                      np.isneginf(x))
+        y = rng.standard_normal((5,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.positive(t(y))), y)
+        a = np.array([5.0, -5.0, 7.5], np.float32)
+        b = np.array([3.0, 3.0, -2.0], np.float32)
+        np.testing.assert_allclose(n(paddle.mod(t(a), t(b))),
+                                   np.mod(a, b), rtol=1e-6)
+        assert paddle.floor_mod is paddle.mod
+
+
+class TestLinalgAliases:
+    def test_cholesky_det_inverse_solve(self, spd4, rng):
+        np.testing.assert_allclose(n(paddle.cholesky(t(spd4))),
+                                   np.linalg.cholesky(spd4), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(n(paddle.det(t(spd4))),
+                                   np.linalg.det(spd4), rtol=1e-3)
+        np.testing.assert_allclose(n(paddle.inverse(t(spd4))),
+                                   np.linalg.inv(spd4), rtol=1e-3,
+                                   atol=1e-4)
+        b = rng.standard_normal((4, 2)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.solve(t(spd4), t(b))),
+                                   np.linalg.solve(spd4, b), rtol=1e-3,
+                                   atol=1e-4)
+        sgn_, logd = paddle.slogdet(t(spd4))
+        ws, wl = np.linalg.slogdet(spd4)
+        assert n(sgn_) == pytest.approx(ws)
+        assert n(logd) == pytest.approx(wl, rel=1e-4)
+
+    def test_qr_svd_pinv_power_rank(self, spd4, a35):
+        q, r = paddle.qr(t(spd4))
+        np.testing.assert_allclose(n(q) @ n(r), spd4, atol=1e-4)
+        u, s, vh = paddle.svd(t(a35))
+        np.testing.assert_allclose(
+            n(u) @ np.diag(n(s)) @ n(vh), a35, atol=1e-4)
+        np.testing.assert_allclose(n(paddle.pinv(t(a35))),
+                                   np.linalg.pinv(a35), atol=1e-4)
+        np.testing.assert_allclose(n(paddle.matrix_power(t(spd4), 3)),
+                                   np.linalg.matrix_power(spd4, 3),
+                                   rtol=1e-3)
+        assert int(n(paddle.matrix_rank(t(spd4)))) == 4
+
+    def test_eig_family_and_lstsq(self, spd4, rng):
+        w = n(paddle.eigvalsh(t(spd4)))
+        np.testing.assert_allclose(np.sort(w),
+                                   np.sort(np.linalg.eigvalsh(spd4)),
+                                   rtol=1e-3)
+        vals, vecs = paddle.eigh(t(spd4))
+        np.testing.assert_allclose(
+            spd4 @ n(vecs), n(vecs) @ np.diag(n(vals)), atol=1e-3)
+        A = rng.standard_normal((6, 3)).astype(np.float32)
+        b = rng.standard_normal((6,)).astype(np.float32)
+        sol = paddle.lstsq(t(A), t(b))
+        want = np.linalg.lstsq(A, b, rcond=None)[0]
+        np.testing.assert_allclose(n(sol[0]).reshape(-1), want, atol=1e-3)
+
+    def test_multi_dot_t_dist_cond(self, rng, spd4):
+        A = rng.standard_normal((3, 4)).astype(np.float32)
+        B = rng.standard_normal((4, 5)).astype(np.float32)
+        C = rng.standard_normal((5, 2)).astype(np.float32)
+        np.testing.assert_allclose(
+            n(paddle.multi_dot([t(A), t(B), t(C)])), A @ B @ C, atol=1e-4)
+        np.testing.assert_allclose(n(paddle.t(t(A))), A.T)
+        x = rng.standard_normal((4,)).astype(np.float32)
+        y = rng.standard_normal((4,)).astype(np.float32)
+        assert n(paddle.dist(t(x), t(y), p=2)) == pytest.approx(
+            np.linalg.norm(x - y), rel=1e-5)
+        assert n(paddle.cond(t(spd4))) == pytest.approx(
+            np.linalg.cond(spd4), rel=1e-3)
+
+    def test_lu_triangular_cholesky_solve(self, spd4, rng):
+        lu_, piv = paddle.lu(t(spd4))[:2]
+        P, L, U = paddle.lu_unpack(lu_, piv)
+        np.testing.assert_allclose(n(P) @ n(L) @ n(U), spd4, atol=1e-3)
+        b = rng.standard_normal((4, 1)).astype(np.float32)
+        Lmat = np.linalg.cholesky(spd4)
+        got = paddle.triangular_solve(t(Lmat), t(b), upper=False)
+        np.testing.assert_allclose(n(got), np.linalg.solve(Lmat, b),
+                                   atol=1e-4)
+        got2 = paddle.cholesky_solve(t(b), t(Lmat), upper=False)
+        np.testing.assert_allclose(n(got2), np.linalg.solve(spd4, b),
+                                   atol=1e-3)
+
+
+class TestAttributesIntrospection:
+    def test_predicates(self, a35):
+        assert paddle.is_tensor(t(a35)) and not paddle.is_tensor(a35)
+        assert paddle.is_floating_point(t(a35))
+        assert not paddle.is_integer(t(a35))
+        assert paddle.is_integer(t(np.arange(3)))
+        assert paddle.is_complex(t(a35.astype(np.complex64)))
+        assert not bool(n(paddle.is_empty(t(a35))))
+        assert bool(n(paddle.is_empty(t(np.zeros((0, 3), np.float32)))))
+
+    def test_numel_rank_shape(self, a35):
+        assert int(n(paddle.numel(t(a35)))) == 15
+        assert int(n(paddle.rank(t(a35)))) == 2
+        np.testing.assert_array_equal(n(paddle.shape(t(a35))), [3, 5])
+        assert paddle.broadcast_shape([2, 1, 4], [3, 1]) == [2, 3, 4]
+
+    def test_tolist_finfo_iinfo(self, a35):
+        assert paddle.tolist(t(a35)) == a35.tolist()
+        assert paddle.finfo("float32").bits == 32
+        assert paddle.iinfo("int16").max == 32767
+
+    def test_rng_state_roundtrip(self):
+        paddle.seed(7)
+        st = paddle.get_rng_state()
+        a = n(paddle.rand([4]))
+        paddle.set_rng_state(st)
+        b = n(paddle.rand([4]))
+        np.testing.assert_array_equal(a, b)
+
+    def test_set_grad_enabled(self, a35):
+        x = t(a35)
+        x.stop_gradient = False
+        with paddle.set_grad_enabled(False):
+            y = (x * 2).sum()
+        assert y.stop_gradient
+        with paddle.set_grad_enabled(True):
+            z = (x * 2).sum()
+        z.backward()
+        assert x.grad is not None
+
+    def test_create_parameter_and_complex(self, rng):
+        p = paddle.create_parameter([4, 8])
+        assert p.trainable and n(p).shape == (4, 8)
+        b = paddle.create_parameter([8], is_bias=True)
+        np.testing.assert_array_equal(n(b), np.zeros(8, np.float32))
+        re = rng.standard_normal((3,)).astype(np.float32)
+        im = rng.standard_normal((3,)).astype(np.float32)
+        np.testing.assert_allclose(n(paddle.complex(t(re), t(im))),
+                                   re + 1j * im)
+
+
+class TestRandomTail:
+    def test_binomial(self):
+        paddle.seed(0)
+        out = n(paddle.binomial(t(np.full((2000,), 10, np.int32)),
+                                t(np.full((2000,), 0.5, np.float32))))
+        assert out.min() >= 0 and out.max() <= 10
+        assert abs(out.mean() - 5.0) < 0.3
+
+    def test_standard_gamma(self):
+        paddle.seed(0)
+        out = n(paddle.standard_gamma(t(np.full((4000,), 3.0, np.float32))))
+        assert out.min() > 0 and abs(out.mean() - 3.0) < 0.3
+
+    def test_log_normal(self):
+        paddle.seed(0)
+        out = n(paddle.log_normal(mean=0.0, std=0.5, shape=[4000]))
+        assert abs(np.log(out).mean()) < 0.1
+
+    def test_randint_like(self, a35):
+        out = paddle.randint_like(t(a35), 5, 10)
+        o = n(out)
+        assert o.shape == a35.shape and o.min() >= 5 and o.max() < 10
+
+    def test_exponential_(self):
+        paddle.seed(0)
+        x = t(np.zeros(4000, np.float32))
+        r = paddle.exponential_(x, lam=2.0)
+        assert r is x
+        assert abs(n(x).mean() - 0.5) < 0.1
+
+
+class TestManipulationStragglers:
+    def test_as_strided(self, rng):
+        a = rng.standard_normal((12,)).astype(np.float32)
+        got = n(paddle.as_strided(t(a), [3, 4], [4, 1]))
+        np.testing.assert_array_equal(got, a.reshape(3, 4))
+        # overlapping windows
+        got2 = n(paddle.as_strided(t(a), [5, 4], [2, 1]))
+        want = np.lib.stride_tricks.as_strided(
+            a, (5, 4), (2 * a.itemsize, a.itemsize))
+        np.testing.assert_array_equal(got2, want)
+
+    def test_view_and_view_as(self, rng):
+        a = rng.standard_normal((2, 6)).astype(np.float32)
+        np.testing.assert_array_equal(n(paddle.view(t(a), [3, 4])),
+                                      a.reshape(3, 4))
+        np.testing.assert_array_equal(
+            n(paddle.view(t(a), "int32")), a.view(np.int32))
+        np.testing.assert_array_equal(
+            n(paddle.view(t(a), "float16")).shape, (2, 12))
+        # widening bitcast (code-review r4: was broken and untested)
+        h = rng.standard_normal((2, 6)).astype(np.float16)
+        np.testing.assert_array_equal(n(paddle.view(t(h), "float32")),
+                                      h.view(np.float32))
+        b = np.zeros((4, 3), np.float32)
+        np.testing.assert_array_equal(
+            n(paddle.view_as(t(a), t(b))), a.reshape(4, 3))
+
+    def test_shard_index(self):
+        labels = np.array([1, 6, 11, 15], np.int32)
+        got = n(paddle.shard_index(t(labels), 16, 2, 0))
+        np.testing.assert_array_equal(got, [1, 6, -1, -1])
+        got = n(paddle.shard_index(t(labels), 16, 2, 1))
+        np.testing.assert_array_equal(got, [-1, -1, 3, 7])
+
+    def test_add_n_clip_by_norm(self, rng):
+        xs = [rng.standard_normal((3, 3)).astype(np.float32)
+              for _ in range(3)]
+        np.testing.assert_allclose(n(paddle.add_n([t(x) for x in xs])),
+                                   sum(xs), rtol=1e-6)
+        v = rng.standard_normal((10,)).astype(np.float32) * 100
+        out = n(paddle.clip_by_norm(t(v), 1.0))
+        assert np.linalg.norm(out) == pytest.approx(1.0, rel=1e-4)
+        small = np.array([0.1, 0.2], np.float32)
+        np.testing.assert_allclose(n(paddle.clip_by_norm(t(small), 5.0)),
+                                   small, rtol=1e-5)
+
+    def test_diagonal_scatter(self, rng):
+        a = rng.standard_normal((4, 4)).astype(np.float32)
+        d = rng.standard_normal((4,)).astype(np.float32)
+        got = n(paddle.diagonal_scatter(t(a), t(d)))
+        want = a.copy()
+        np.fill_diagonal(want, d)
+        np.testing.assert_allclose(got, want)
+        d3 = rng.standard_normal((3,)).astype(np.float32)
+        got = n(paddle.diagonal_scatter(t(a), t(d3), offset=1))
+        want = a.copy()
+        for i in range(3):
+            want[i, i + 1] = d3[i]
+        np.testing.assert_allclose(got, want)
+
+
+class TestInplaceVariants:
+    def test_elementwise_inplace_contract(self, rng):
+        """Every generated in-place op returns the SAME Tensor object with
+        storage equal to its pure twin's result."""
+        cases = {
+            "abs_": ([-1.0, 2.0], (), np.abs),
+            "ceil_": ([1.2, -1.2], (), np.ceil),
+            "exp_": ([0.5, 1.0], (), np.exp),
+            "floor_": ([1.8, -0.2], (), np.floor),
+            "log_": ([1.0, 4.0], (), np.log),
+            "log2_": ([1.0, 8.0], (), np.log2),
+            "log10_": ([1.0, 100.0], (), np.log10),
+            "log1p_": ([0.0, 1.0], (), np.log1p),
+            "neg_": ([1.0, -2.0], (), np.negative),
+            "reciprocal_": ([2.0, 4.0], (), np.reciprocal),
+            "round_": ([1.4, 2.6], (), np.round),
+            "rsqrt_": ([4.0, 16.0], (), lambda a: 1 / np.sqrt(a)),
+            "sqrt_": ([4.0, 9.0], (), np.sqrt),
+            "square_": ([3.0, -2.0], (), np.square),
+            "sin_": ([0.5, 1.0], (), np.sin),
+            "cos_": ([0.5, 1.0], (), np.cos),
+            "tan_": ([0.5, 1.0], (), np.tan),
+            "sinh_": ([0.5, 1.0], (), np.sinh),
+            "cosh_": ([0.5, 1.0], (), np.cosh),
+            "tanh_": ([0.5, 1.0], (), np.tanh),
+            "asin_": ([0.3, 0.6], (), np.arcsin),
+            "acos_": ([0.3, 0.6], (), np.arccos),
+            "atan_": ([0.3, 0.6], (), np.arctan),
+            "asinh_": ([0.3, 0.6], (), np.arcsinh),
+            "acosh_": ([1.5, 2.5], (), np.arccosh),
+            "atanh_": ([0.3, 0.6], (), np.arctanh),
+            "expm1_": ([0.3, 0.6], (), np.expm1),
+            "trunc_": ([1.7, -1.7], (), np.trunc),
+            "erfinv_": ([0.1, 0.5], (), sps.erfinv),
+        }
+        for name, (vals, args, ref) in cases.items():
+            x = t(np.asarray(vals, np.float32))
+            r = getattr(paddle, name)(x, *args)
+            assert r is x, name
+            np.testing.assert_allclose(n(x), ref(np.asarray(
+                vals, np.float32)), rtol=1e-4, atol=1e-5, err_msg=name)
+
+    def test_binary_inplace(self, rng):
+        a = rng.standard_normal((4,)).astype(np.float32)
+        b = rng.standard_normal((4,)).astype(np.float32) + 2.0
+        for name, ref in (("add_", np.add), ("subtract_", np.subtract),
+                          ("multiply_", np.multiply),
+                          ("divide_", np.divide),
+                          ("remainder_", np.mod),
+                          ("floor_divide_", np.floor_divide),
+                          ("copysign_", np.copysign),
+                          ("hypot_", np.hypot),
+                          ("pow_", np.power)):
+            x = t(a.copy())
+            r = getattr(paddle, name)(x, t(b))
+            assert r is x, name
+            np.testing.assert_allclose(n(x), ref(a, b), rtol=1e-4,
+                                       atol=1e-5, err_msg=name)
+        ia = np.array([12, 18], np.int32)
+        ib = np.array([8, 12], np.int32)
+        x = t(ia.copy())
+        assert paddle.gcd_(x, t(ib)) is x
+        np.testing.assert_array_equal(n(x), np.gcd(ia, ib))
+        x = t(ia.copy())
+        assert paddle.lcm_(x, t(ib)) is x
+        np.testing.assert_array_equal(n(x), np.lcm(ia, ib))
+
+    def test_shape_inplace(self, rng):
+        a = rng.standard_normal((2, 3)).astype(np.float32)
+        x = t(a)
+        assert paddle.reshape_(x, [3, 2]) is x and n(x).shape == (3, 2)
+        assert paddle.flatten_(x) is x and n(x).shape == (6,)
+        assert paddle.unsqueeze_(x, 0) is x and n(x).shape == (1, 6)
+        assert paddle.squeeze_(x) is x and n(x).shape == (6,)
+        m = t(rng.standard_normal((3, 3)).astype(np.float32))
+        assert paddle.tril_(m) is m
+        assert np.allclose(n(m), np.tril(n(m)))
+        assert paddle.triu_(m) is m  # tril then triu → diagonal only
+        assert np.count_nonzero(n(m) - np.diag(np.diag(n(m)))) == 0
+
+    def test_fill_zero_diag_uniform(self, rng):
+        x = t(rng.standard_normal((3, 3)).astype(np.float32))
+        assert paddle.fill_(x, 2.5) is x
+        np.testing.assert_array_equal(n(x), np.full((3, 3), 2.5,
+                                                    np.float32))
+        assert paddle.zero_(x) is x
+        np.testing.assert_array_equal(n(x), np.zeros((3, 3), np.float32))
+        assert paddle.fill_diagonal_(x, 7.0) is x
+        np.testing.assert_array_equal(n(x), np.diag([7.0] * 3).astype(
+            np.float32))
+        paddle.seed(3)
+        assert paddle.uniform_(x, min=0.0, max=1.0) is x
+        assert n(x).min() >= 0 and n(x).max() <= 1 and n(x).std() > 0
+
+    def test_data_inplace(self, rng):
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        x = t(a.copy())
+        m = a > 0
+        assert paddle.masked_fill_(x, t(m), -9.0) is x
+        np.testing.assert_allclose(n(x), np.where(m, -9.0, a))
+        x = t(a.copy())
+        assert paddle.clip_(x, -0.5, 0.5) is x
+        np.testing.assert_allclose(n(x), np.clip(a, -0.5, 0.5))
+        x = t(a.copy())
+        assert paddle.scale_(x, 2.0) is x
+        np.testing.assert_allclose(n(x), a * 2.0, rtol=1e-6)
+        x = t(a.copy())
+        assert paddle.nan_to_num_(x) is x
+        x = t(np.array([1.0, 2.0], np.float32))
+        assert paddle.lerp_(x, t(np.array([3.0, 6.0], np.float32)),
+                            0.5) is x
+        np.testing.assert_allclose(n(x), [2.0, 4.0])
+        base = t(a.copy())
+        idx = np.array([0, 2], np.int32)
+        upd = rng.standard_normal((2, 4)).astype(np.float32)
+        assert paddle.index_add_(base, t(idx), 0, t(upd)) is base
+        want = a.copy()
+        np.add.at(want, idx, upd)
+        np.testing.assert_allclose(n(base), want, rtol=1e-5)
+
+
+class TestCompleteness:
+    def test_every_export_resolves(self):
+        missing = [name for name in longtail2.__all__
+                   if not hasattr(paddle, name)]
+        assert not missing, missing
+
+    def test_export_count(self):
+        # the r4 target: >= 450 public names at the paddle_tpu root
+        names = [s for s in dir(paddle) if not s.startswith("_")]
+        assert len(names) >= 450, len(names)

@@ -1,0 +1,230 @@
+"""Sparse COO/CSR compute with gradients + fft family with grad parity
+(VERDICT r3 #6 — the two-round-old breadth debt; reference:
+python/paddle/sparse/ spmm/SDDMM kernels, python/paddle/fft.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import fft, sparse
+from paddle_tpu.framework.tensor import Tensor
+
+
+def n(x):
+    return np.asarray(x._data if isinstance(x, Tensor) else x)
+
+
+@pytest.fixture
+def coo(rng):
+    """4x5 sparse matrix with 6 nnz (one duplicate-free coordinate set)."""
+    idx = np.array([[0, 0, 1, 2, 3, 3], [0, 3, 1, 4, 0, 2]], np.int32)
+    vals = rng.standard_normal((6,)).astype(np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, [4, 5]), idx, vals
+
+
+class TestSparseCreateDense:
+    def test_coo_roundtrip(self, coo):
+        sp, idx, vals = coo
+        assert sp.nnz() == 6 and sp.shape == [4, 5]
+        dense = np.zeros((4, 5), np.float32)
+        dense[idx[0], idx[1]] = vals
+        np.testing.assert_allclose(n(sp.to_dense()), dense)
+        np.testing.assert_array_equal(n(sp.indices()), idx)
+        np.testing.assert_allclose(n(sp.values()), vals)
+
+    def test_csr_roundtrip(self, rng):
+        crows = np.array([0, 2, 3, 3, 5], np.int32)
+        cols = np.array([1, 3, 2, 0, 4], np.int32)
+        vals = rng.standard_normal((5,)).astype(np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, [4, 5])
+        dense = np.zeros((4, 5), np.float32)
+        rows = np.repeat(np.arange(4), np.diff(crows))
+        dense[rows, cols] = vals
+        np.testing.assert_allclose(n(sp.to_dense()), dense)
+
+    def test_coalesce_merges_duplicates(self):
+        idx = np.array([[0, 0, 1], [1, 1, 0]], np.int32)
+        sp = sparse.sparse_coo_tensor(
+            idx, np.array([1.0, 2.0, 5.0], np.float32), [2, 2])
+        c = sp.coalesce()
+        assert c.nnz() == 2
+        np.testing.assert_allclose(n(c.to_dense()),
+                                   [[0.0, 3.0], [5.0, 0.0]])
+
+
+class TestSparseMatmulGrads:
+    def test_spmm_forward_and_grads(self, coo, rng):
+        sp, idx, vals = coo
+        y = rng.standard_normal((5, 3)).astype(np.float32)
+        out = sparse.matmul(sp, Tensor(y))
+        np.testing.assert_allclose(n(out), n(sp.to_dense()) @ y,
+                                   rtol=1e-5, atol=1e-6)
+        # eager-tape grads: d(sum(out))/d(values) and /d(y)
+        sp2 = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+        sp2.values().stop_gradient = False
+        yt = Tensor(y)
+        yt.stop_gradient = False
+        loss = sparse.matmul(sp2, yt).sum()
+        loss.backward()
+        # reference grads via dense autodiff
+        def dense_loss(v, yd):
+            d = jnp.zeros((4, 5), jnp.float32).at[tuple(idx)].set(v)
+            return jnp.sum(d @ yd)
+        gv, gy = jax.grad(dense_loss, argnums=(0, 1))(
+            jnp.asarray(vals), jnp.asarray(y))
+        np.testing.assert_allclose(n(sp2.values().grad), gv, rtol=1e-5)
+        np.testing.assert_allclose(n(yt.grad), gy, rtol=1e-5)
+
+    def test_dense_times_sparse(self, coo, rng):
+        sp, idx, vals = coo
+        x = rng.standard_normal((2, 4)).astype(np.float32)
+        out = sparse.matmul(Tensor(x), sp)
+        np.testing.assert_allclose(n(out), x @ n(sp.to_dense()),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_csr_matmul(self, rng):
+        crows = np.array([0, 2, 3, 3, 5], np.int32)
+        cols = np.array([1, 3, 2, 0, 4], np.int32)
+        vals = rng.standard_normal((5,)).astype(np.float32)
+        sp = sparse.sparse_csr_tensor(crows, cols, vals, [4, 5])
+        y = rng.standard_normal((5, 2)).astype(np.float32)
+        np.testing.assert_allclose(n(sparse.matmul(sp, Tensor(y))),
+                                   n(sp.to_dense()) @ y, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_masked_matmul_sddmm_and_grads(self, coo, rng):
+        sp, idx, _ = coo
+        x = rng.standard_normal((4, 7)).astype(np.float32)
+        y = rng.standard_normal((7, 5)).astype(np.float32)
+        out = sparse.masked_matmul(Tensor(x), Tensor(y), sp)
+        assert sparse.is_sparse(out) and out.nnz() == sp.nnz()
+        full = x @ y
+        np.testing.assert_allclose(n(out.values()),
+                                   full[idx[0], idx[1]], rtol=1e-5)
+        xt, yt = Tensor(x), Tensor(y)
+        xt.stop_gradient = yt.stop_gradient = False
+        loss = sparse.masked_matmul(xt, yt, sp).values().sum()
+        loss.backward()
+
+        def dense_loss(xd, yd):
+            full = xd @ yd
+            return jnp.sum(full[tuple(idx)])
+
+        gx, gy = jax.grad(dense_loss, argnums=(0, 1))(
+            jnp.asarray(x), jnp.asarray(y))
+        np.testing.assert_allclose(n(xt.grad), gx, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(n(yt.grad), gy, rtol=1e-5, atol=1e-6)
+
+    def test_sparse_add_sparse(self, rng):
+        i1 = np.array([[0, 1], [1, 0]], np.int32)
+        i2 = np.array([[0, 1], [1, 1]], np.int32)
+        s1 = sparse.sparse_coo_tensor(
+            i1, np.array([1.0, 2.0], np.float32), [2, 2])
+        s2 = sparse.sparse_coo_tensor(
+            i2, np.array([10.0, 20.0], np.float32), [2, 2])
+        out = sparse.add(s1, s2)
+        assert sparse.is_sparse(out)
+        np.testing.assert_allclose(n(out.to_dense()),
+                                   [[0.0, 11.0], [2.0, 20.0]])
+
+    def test_csr_add_csr_stays_csr(self, rng):
+        """code-review r4: CSR+CSR must return CSR, not fall to dense."""
+        a = sparse.sparse_csr_tensor(
+            np.array([0, 1, 2], np.int32), np.array([0, 1], np.int32),
+            np.array([1.0, 2.0], np.float32), [2, 2])
+        b = sparse.sparse_csr_tensor(
+            np.array([0, 1, 2], np.int32), np.array([1, 1], np.int32),
+            np.array([10.0, 20.0], np.float32), [2, 2])
+        out = sparse.add(a, b)
+        assert isinstance(out, sparse.SparseCsrTensor)
+        np.testing.assert_allclose(n(out.to_dense()),
+                                   [[1.0, 10.0], [0.0, 22.0]])
+        np.testing.assert_array_equal(n(out.crows()), [0, 2, 3])
+
+    def test_hfftn_with_s_only(self, rng):
+        """code-review r4: s given with axes=None must use the LAST
+        len(s) axes (fftn-family convention)."""
+        c = (rng.standard_normal((3, 4, 6))
+             + 1j * rng.standard_normal((3, 4, 6))).astype(np.complex64)
+        got = n(fft.hfftn(Tensor(c), s=(4, 10)))
+        want = np.fft.hfft(np.fft.fft(c, n=4, axis=-2), n=10, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestFFT:
+    def test_forward_matches_numpy(self, rng):
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        np.testing.assert_allclose(n(fft.fft(Tensor(x))),
+                                   np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(n(fft.rfft(Tensor(x))),
+                                   np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(n(fft.fft2(Tensor(x))),
+                                   np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        c = (x + 1j * rng.standard_normal((4, 8))).astype(np.complex64)
+        np.testing.assert_allclose(n(fft.ifft(Tensor(c))),
+                                   np.fft.ifft(c), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(n(fft.hfft(Tensor(c))),
+                                   np.fft.hfft(c), rtol=1e-3, atol=1e-3)
+
+    def test_hfft2_hfftn_family(self, rng):
+        c = (rng.standard_normal((4, 6))
+             + 1j * rng.standard_normal((4, 6))).astype(np.complex64)
+        got = n(fft.hfft2(Tensor(c)))
+        want = np.fft.hfft(np.fft.fft(c, axis=-2), axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+        x = rng.standard_normal((4, 6)).astype(np.float32)
+        got = n(fft.ihfft2(Tensor(x)))
+        want = np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=-2)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+        got = n(fft.hfftn(Tensor(c)))
+        np.testing.assert_allclose(
+            got, np.fft.hfft(np.fft.fft(c, axis=0), axis=1),
+            rtol=1e-3, atol=1e-3)
+        got = n(fft.ihfftn(Tensor(x)))
+        np.testing.assert_allclose(
+            got, np.fft.ifft(np.fft.ihfft(x, axis=1), axis=0),
+            rtol=1e-3, atol=1e-4)
+
+    def test_rfft_grad_parity(self, rng):
+        """Gradients through the fft ops match jax-level autodiff of the
+        same jnp primitives (the reference's fft_grad kernels)."""
+        x = rng.standard_normal((8,)).astype(np.float32)
+
+        def loss_tape(a):
+            t = Tensor(a)
+            t.stop_gradient = False
+            out = fft.rfft(t)
+            l = out.abs().sum()
+            l.backward()
+            return n(t.grad)
+
+        def loss_jax(a):
+            return jnp.sum(jnp.abs(jnp.fft.rfft(a)))
+
+        np.testing.assert_allclose(loss_tape(x),
+                                   np.asarray(jax.grad(loss_jax)(
+                                       jnp.asarray(x))),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_irfft_roundtrip_grad(self, rng):
+        x = rng.standard_normal((8,)).astype(np.float32)
+        t = Tensor(x)
+        t.stop_gradient = False
+        out = fft.irfft(fft.rfft(t))
+        np.testing.assert_allclose(n(out), x, rtol=1e-4, atol=1e-5)
+        out.sum().backward()
+        # d(sum(irfft(rfft(x))))/dx == ones (identity map)
+        np.testing.assert_allclose(n(t.grad), np.ones(8), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_freq_and_shift(self):
+        np.testing.assert_allclose(n(fft.fftfreq(8, 0.5)),
+                                   np.fft.fftfreq(8, 0.5))
+        np.testing.assert_allclose(n(fft.rfftfreq(8, 0.5)),
+                                   np.fft.rfftfreq(8, 0.5))
+        x = np.arange(8, dtype=np.float32)
+        np.testing.assert_array_equal(n(fft.fftshift(Tensor(x))),
+                                      np.fft.fftshift(x))
+        np.testing.assert_array_equal(n(fft.ifftshift(Tensor(x))),
+                                      np.fft.ifftshift(x))

@@ -64,5 +64,7 @@ from .auto_parallel import (  # noqa: F401
     shard_tensor,
 )
 from .auto_parallel_static import Engine  # noqa: F401
+from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from . import communication  # noqa: F401

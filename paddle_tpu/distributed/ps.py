@@ -1,0 +1,247 @@
+"""Parameter-server training mode (SURVEY A17/C20 — recorded as the last
+capability gap since round 1; reference: ``paddle/fluid/distributed/ps/``
+dense/sparse tables behind brpc, surfaced through fleet's PS mode for
+recommender models).
+
+TPU-era design: the collective path (fleet + pjit) is the flagship — PS
+mode exists for the reference's recommender workloads, where the model is
+mostly a huge sparse embedding that cannot replicate. This implementation
+keeps exactly that capability, over the framework's own Python RPC layer
+(``distributed.rpc``, SURVEY A18's sanctioned transport):
+
+* **Dense tables**: named fp32 arrays + a server-side SGD/Adam-style
+  update; workers ``pull_dense``/``push_dense`` whole arrays.
+* **Sparse tables**: row-sharded embeddings created lazily on first touch
+  (the reference's ctr/accessor behavior): ``pull_sparse(ids)`` gathers
+  rows, ``push_sparse(ids, grads)`` applies per-row updates server-side.
+  Duplicate ids in one push accumulate, matching scatter-add semantics.
+* **Async by default**: each push applies immediately (the reference's
+  async-SGD mode); ``barrier()`` gives sync-mode epoch edges.
+
+Roles follow the reference's env contract: ``PADDLE_TRAINING_ROLE``
+(``PSERVER``/``TRAINER``), with explicit args taking precedence.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import rpc
+
+__all__ = ["ParameterServer", "init_ps", "pull_dense", "push_dense",
+           "pull_sparse", "push_sparse", "register_dense", "barrier",
+           "shutdown", "is_server", "is_worker", "server_name"]
+
+
+class ParameterServer:
+    """Server-side state: dense + sparse tables and their optimizer."""
+
+    def __init__(self, lr: float = 0.01, optimizer: str = "sgd",
+                 sparse_dim: int = 8, initializer=None):
+        if optimizer not in ("sgd", "adagrad"):
+            raise ValueError("ParameterServer optimizer: sgd | adagrad")
+        self.lr = float(lr)
+        self.optimizer = optimizer
+        self.sparse_dim = int(sparse_dim)
+        self.initializer = initializer or (
+            lambda shape: np.random.default_rng(0).standard_normal(
+                shape).astype(np.float32) * 0.01)
+        self._dense: Dict[str, np.ndarray] = {}
+        self._dense_acc: Dict[str, np.ndarray] = {}
+        self._sparse: Dict[str, Dict[int, np.ndarray]] = {}
+        self._sparse_acc: Dict[str, Dict[int, np.ndarray]] = {}
+        self._mu = threading.Lock()
+
+    # ---------------------------------------------------------- dense
+    def register_dense(self, name: str, value: np.ndarray):
+        with self._mu:
+            if name not in self._dense:  # first registration wins
+                self._dense[name] = np.array(value, np.float32)
+        return True
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        with self._mu:
+            return self._dense[name].copy()
+
+    def push_dense(self, name: str, grad: np.ndarray):
+        g = np.asarray(grad, np.float32)
+        with self._mu:
+            p = self._dense[name]
+            if self.optimizer == "adagrad":
+                acc = self._dense_acc.setdefault(
+                    name, np.zeros_like(p))
+                acc += g * g
+                p -= self.lr * g / (np.sqrt(acc) + 1e-8)
+            else:
+                p -= self.lr * g
+        return True
+
+    # --------------------------------------------------------- sparse
+    def _row(self, table: str, i: int) -> np.ndarray:
+        rows = self._sparse.setdefault(table, {})
+        if i not in rows:  # lazy create on first touch (ctr accessor)
+            rows[i] = self.initializer((self.sparse_dim,))
+        return rows[i]
+
+    def pull_sparse(self, table: str, ids) -> np.ndarray:
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._mu:
+            return np.stack([self._row(table, int(i)) for i in ids])
+
+    def push_sparse(self, table: str, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(len(ids), -1)
+        with self._mu:
+            acc_tab = self._sparse_acc.setdefault(table, {})
+            for i, g in zip(ids, grads):
+                i = int(i)
+                row = self._row(table, i)
+                if self.optimizer == "adagrad":
+                    acc = acc_tab.setdefault(
+                        i, np.zeros_like(row))
+                    acc += g * g
+                    row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                else:
+                    row -= self.lr * g
+        return True
+
+    def stats(self):
+        with self._mu:
+            return {"dense": sorted(self._dense),
+                    "sparse_rows": {t: len(r)
+                                    for t, r in self._sparse.items()}}
+
+
+# -------------------------------------------------- module-level service
+# RPC ships (fn, args) by reference to importable functions; these
+# closures over the process-global server instance are the service
+# surface a PSERVER process exposes.
+
+_SERVER: Optional[ParameterServer] = None
+_ROLE = {"role": None, "server": "ps0"}
+
+
+def _srv() -> ParameterServer:
+    if _SERVER is None:
+        raise RuntimeError("this process is not a parameter server")
+    return _SERVER
+
+
+def _rpc_register_dense(name, value):
+    return _srv().register_dense(name, value)
+
+
+def _rpc_pull_dense(name):
+    return _srv().pull_dense(name)
+
+
+def _rpc_push_dense(name, grad):
+    return _srv().push_dense(name, grad)
+
+
+def _rpc_pull_sparse(table, ids):
+    return _srv().pull_sparse(table, ids)
+
+
+def _rpc_push_sparse(table, ids, grads):
+    return _srv().push_sparse(table, ids, grads)
+
+
+def _rpc_stats():
+    return _srv().stats()
+
+
+# ------------------------------------------------------------ client API
+
+
+def init_ps(name: str, rank: int, world_size: int,
+            master_endpoint: str = "127.0.0.1:29600", role: str = None,
+            server_name: str = "ps0", **server_kw):
+    """Join a PS world: exactly one PSERVER (named ``server_name``) plus
+    trainers. ``role`` defaults from PADDLE_TRAINING_ROLE."""
+    global _SERVER
+    role = (role or os.environ.get("PADDLE_TRAINING_ROLE", "TRAINER")
+            ).upper()
+    if role not in ("PSERVER", "TRAINER"):
+        raise ValueError(f"bad PS role {role!r}")
+    if role == "PSERVER":
+        _SERVER = ParameterServer(**server_kw)
+    _ROLE["role"] = role
+    _ROLE["server"] = server_name
+    rpc.init_rpc(name, rank, world_size, master_endpoint)
+    return _ROLE["role"]
+
+
+def is_server() -> bool:
+    return _ROLE["role"] == "PSERVER"
+
+
+def is_worker() -> bool:
+    return _ROLE["role"] == "TRAINER"
+
+
+def server_name() -> str:
+    return _ROLE["server"]
+
+
+def register_dense(name: str, value):
+    return rpc.rpc_sync(_ROLE["server"], _rpc_register_dense,
+                        (name, np.asarray(value, np.float32)))
+
+
+def pull_dense(name: str) -> np.ndarray:
+    return rpc.rpc_sync(_ROLE["server"], _rpc_pull_dense, (name,))
+
+
+_PENDING = []
+_PENDING_MU = threading.Lock()
+
+
+def _track(fut):
+    with _PENDING_MU:
+        _PENDING.append(fut)
+        if len(_PENDING) > 256:  # opportunistic cleanup
+            _PENDING[:] = [f for f in _PENDING if not f.done()]
+    return fut
+
+
+def push_dense(name: str, grad, sync: bool = False):
+    g = np.asarray(grad, np.float32)
+    if sync:
+        return rpc.rpc_sync(_ROLE["server"], _rpc_push_dense, (name, g))
+    return _track(rpc.rpc_async(_ROLE["server"], _rpc_push_dense,
+                                (name, g)))
+
+
+def pull_sparse(table: str, ids) -> np.ndarray:
+    return rpc.rpc_sync(_ROLE["server"], _rpc_pull_sparse, (table, ids))
+
+
+def push_sparse(table: str, ids, grads, sync: bool = False):
+    a = (np.asarray(ids), np.asarray(grads, np.float32))
+    if sync:
+        return rpc.rpc_sync(_ROLE["server"], _rpc_push_sparse,
+                            (table,) + a)
+    return _track(rpc.rpc_async(_ROLE["server"], _rpc_push_sparse,
+                                (table,) + a))
+
+
+def barrier():
+    """Sync-mode edge: wait for THIS worker's outstanding async pushes
+    to be applied server-side (async pushes ride separate connections,
+    so the fence is the futures themselves)."""
+    with _PENDING_MU:
+        pending, _PENDING[:] = list(_PENDING), []
+    for f in pending:
+        f.result(timeout=120)
+    return rpc.rpc_sync(_ROLE["server"], _rpc_stats, ())
+
+
+def shutdown(graceful: bool = True):
+    global _SERVER
+    rpc.shutdown(graceful)
+    _SERVER = None
+    _ROLE["role"] = None

@@ -429,6 +429,9 @@ def frac(x, name=None):
 
 
 def frac_(x):
+    from .longtail2 import _inplace_guard
+
+    _inplace_guard(x, "frac_")
     out = frac(x)
     x.set_value(out)
     return x

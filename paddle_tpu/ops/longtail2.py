@@ -284,17 +284,10 @@ class set_grad_enabled:
         return self._cm.__exit__(*exc)
 
 
-def get_rng_state():
-    """Snapshot of the global generator (seed, counter) — paddle returns
-    opaque GeneratorState objects; ours is a picklable tuple."""
-    return (_random.get_seed(), _random._state["counter"])
-
-
-def set_rng_state(state):
-    s, c = state
-    _random.seed(int(s))
-    with _random._lock:
-        _random._state["counter"] = int(c)
+# get/set_rng_state: reuse the ONE implementation in framework.random
+# (a second, format-incompatible pair here shadowed it at the package
+# root — code-review r4)
+from ..framework.random import get_rng_state, set_rng_state  # noqa: E402
 
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,
@@ -467,14 +460,34 @@ def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
 # from the pure ops so the two can never drift.
 
 
+def _inplace_guard(x, opname):
+    """In-place storage replacement cannot be recorded on the tape (and
+    aliasing an already-consumed tensor would corrupt earlier nodes'
+    gradients), so in-place ops on autograd-TRACKED tensors raise instead
+    of silently dropping the VJP (code-review r4). Under ``no_grad()`` —
+    the optimizer/update pattern — they are fine; so are stop_gradient
+    tensors (the data-manipulation case)."""
+    from ..framework.tensor import is_grad_enabled
+
+    if (isinstance(x, Tensor) and not x.stop_gradient
+            and is_grad_enabled()):
+        raise RuntimeError(
+            f"{opname}: in-place op on a gradient-tracked Tensor is not "
+            "supported (the tape cannot alias storage) — use the pure op "
+            "or wrap the update in paddle.no_grad()")
+
+
 def _make_inplace(pure_fn):
     def fn_(x, *args, **kwargs):
+        _inplace_guard(x, pure_fn.__name__ + "_")
         out = pure_fn(x, *args, **kwargs)
         x.set_value(out)
         return x
 
     fn_.__name__ = pure_fn.__name__ + "_"
-    fn_.__doc__ = f"In-place variant of ``{pure_fn.__name__}``."
+    fn_.__doc__ = (f"In-place variant of ``{pure_fn.__name__}`` "
+                   "(raises on gradient-tracked tensors; see "
+                   "_inplace_guard).")
     return fn_
 
 
@@ -512,6 +525,7 @@ def _register_inplace():
 
 
 def fill_(x, value):
+    _inplace_guard(x, "fill_")
     x.set_value(Tensor._wrap(jnp.full_like(_t(x)._data, value)))
     return x
 
@@ -521,6 +535,8 @@ def zero_(x):
 
 
 def fill_diagonal_(x, value, offset=0, wrap=False):
+    _inplace_guard(x, "fill_diagonal_")
+
     def fn(a):
         n1, n2 = a.shape[-2], a.shape[-1]
         k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
@@ -533,6 +549,7 @@ def fill_diagonal_(x, value, offset=0, wrap=False):
 
 
 def uniform_(x, min=-1.0, max=1.0, seed=0):
+    _inplace_guard(x, "uniform_")
     arr = _t(x)._data
     x.set_value(Tensor._wrap(jax.random.uniform(
         _random.next_key(), arr.shape, arr.dtype, minval=min, maxval=max)))
@@ -541,6 +558,7 @@ def uniform_(x, min=-1.0, max=1.0, seed=0):
 
 def exponential_(x, lam=1.0):
     """Fill with Exponential(lam) samples (paddle.Tensor.exponential_)."""
+    _inplace_guard(x, "exponential_")
     arr = _t(x)._data
     u = jax.random.uniform(_random.next_key(), arr.shape, jnp.float32,
                            minval=jnp.finfo(jnp.float32).tiny, maxval=1.0)

@@ -312,11 +312,24 @@ class CosineAnnealingWarmRestarts(LRScheduler):
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
+        # closed forms keep this O(1) per step (a subtract loop makes a
+        # long run quadratic in scheduler cost — code-review r4)
         epoch = max(self.last_epoch, 0)
-        t_i, t_cur = self.T_0, epoch
-        while t_cur >= t_i:
-            t_cur -= t_i
-            t_i *= self.T_mult
+        if self.T_mult == 1:
+            t_i, t_cur = self.T_0, epoch % self.T_0
+        else:
+            n = int(math.log(epoch * (self.T_mult - 1) / self.T_0 + 1,
+                             self.T_mult))
+            start = self.T_0 * (self.T_mult ** n - 1) // (self.T_mult - 1)
+            if start > epoch:  # float-log boundary correction
+                n -= 1
+                start = (self.T_0 * (self.T_mult ** n - 1)
+                         // (self.T_mult - 1))
+            t_i = self.T_0 * self.T_mult ** n
+            t_cur = epoch - start
+            if t_cur >= t_i:  # boundary rounded the other way
+                t_cur -= t_i
+                t_i *= self.T_mult
         return self.eta_min + (self.base_lr - self.eta_min) * (
             1 + math.cos(math.pi * t_cur / t_i)) / 2
 

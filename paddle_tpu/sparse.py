@@ -169,6 +169,18 @@ def _coo_rows_cols(x):
     return x._rows(), x._cols
 
 
+def _check_inner(sp_shape, dense, sp_side, dense_axis, opname):
+    """Shape validation BEFORE the gather: XLA clamps out-of-bounds
+    gather indices, so a mismatched matmul would return plausible garbage
+    instead of raising (code-review r4)."""
+    want = sp_shape[1] if sp_side == "left" else sp_shape[0]
+    got = dense.shape[dense_axis]
+    if got != want:
+        raise ValueError(
+            f"{opname}: dense dim {got} incompatible with sparse shape "
+            f"{tuple(sp_shape)}")
+
+
 def matmul(x, y):
     """sparse @ dense via gather + segment-sum — NEVER densifies the
     sparse operand, and gradients flow to both the sparse values and the
@@ -177,6 +189,7 @@ def matmul(x, y):
         rows, cols = _coo_rows_cols(x)
         m = x._shape[0]
         yt = y if isinstance(y, Tensor) else Tensor(y)
+        _check_inner(x._shape, yt._data, "left", 0, "sparse.matmul")
 
         def fn(vals, yd):
             contrib = vals[:, None] * yd[cols]        # [nnz, N]
@@ -187,6 +200,7 @@ def matmul(x, y):
         rows, cols = _coo_rows_cols(y)
         n = y._shape[1]
         xt = x if isinstance(x, Tensor) else Tensor(x)
+        _check_inner(y._shape, xt._data, "right", -1, "sparse.matmul")
 
         def fn(vals, xd):
             contrib = vals[:, None] * xd.T[rows]      # [nnz, M]
@@ -206,6 +220,12 @@ def masked_matmul(x, y, mask):
     rows, cols = _coo_rows_cols(mask)
     xt = x if isinstance(x, Tensor) else Tensor(x)
     yt = y if isinstance(y, Tensor) else Tensor(y)
+    if (xt._data.shape[0] != mask._shape[0]
+            or yt._data.shape[-1] != mask._shape[1]
+            or xt._data.shape[-1] != yt._data.shape[0]):
+        raise ValueError(
+            f"masked_matmul: shapes {xt._data.shape} @ {yt._data.shape} "
+            f"do not produce mask shape {tuple(mask._shape)}")
 
     def fn(xd, yd):
         return jnp.sum(xd[rows] * yd.T[cols], axis=-1)  # [nnz]
@@ -241,7 +261,11 @@ def add(x, y):
     """sparse+sparse stays sparse in the LEFT operand's format
     (concatenated coordinates, coalesced); anything involving a dense
     operand returns dense. Differentiable."""
-    if is_sparse(x) and is_sparse(y) and tuple(x._shape) == tuple(y._shape):
+    if is_sparse(x) and is_sparse(y):
+        if tuple(x._shape) != tuple(y._shape):
+            raise ValueError(
+                f"sparse.add: shapes {tuple(x._shape)} and "
+                f"{tuple(y._shape)} must match (no sparse broadcasting)")
         idx = jnp.concatenate([_coo_of(x), _coo_of(y)], axis=1)
         vals = apply_op(lambda a, b: jnp.concatenate([a, b]),
                         x._values_t, y._values_t)

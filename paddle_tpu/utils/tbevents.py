@@ -104,13 +104,24 @@ def _version_event(wall_time: float) -> bytes:
 
 
 class EventFileWriter:
-    """Minimal ``SummaryWriter``-alike: ``add_scalar`` + ``close``."""
+    """Minimal ``SummaryWriter``-alike: ``add_scalar`` + ``close``.
+    Writing after ``close()`` reopens a fresh event file in the same
+    log_dir (torch's SummaryWriter behaves this way, and the hapi
+    VisualDL callback relies on it across fit -> evaluate)."""
 
     def __init__(self, log_dir: str):
-        os.makedirs(log_dir, exist_ok=True)
+        self.log_dir = log_dir
+        self._f = None
+        self._open()
+
+    def _open(self):
+        os.makedirs(self.log_dir, exist_ok=True)
         now = time.time()
-        name = f"events.out.tfevents.{int(now)}.{os.uname().nodename}"
-        self._f = open(os.path.join(log_dir, name), "ab")
+        # pid + a per-writer nonce keep reopened files distinct
+        name = (f"events.out.tfevents.{int(now)}."
+                f"{os.uname().nodename}.{os.getpid()}."
+                f"{id(self) & 0xFFFF}")
+        self._f = open(os.path.join(self.log_dir, name), "ab")
         self._record(_version_event(now))
 
     def _record(self, payload: bytes):
@@ -122,6 +133,8 @@ class EventFileWriter:
         self._f.flush()
 
     def add_scalar(self, tag: str, value: float, step: int):
+        if self._f is None:
+            self._open()
         self._record(_scalar_event(tag, value, step, time.time()))
 
     def close(self):

@@ -436,6 +436,29 @@ class TestInplaceVariants:
         np.testing.assert_allclose(n(base), want, rtol=1e-5)
 
 
+class TestInplaceAutogradGuard:
+    def test_inplace_on_tracked_tensor_raises(self, rng):
+        """code-review r4: set_value cannot be recorded on the tape, so
+        in-place on a gradient-tracked tensor must raise loudly instead
+        of silently dropping the op's VJP."""
+        x = t(np.array([4.0, 9.0], np.float32))
+        x.stop_gradient = False
+        y = x * 2  # non-leaf, tracked
+        with pytest.raises(RuntimeError, match="in-place"):
+            paddle.sqrt_(y)
+        with pytest.raises(RuntimeError, match="in-place"):
+            paddle.fill_(y, 1.0)
+
+    def test_inplace_allowed_under_no_grad(self, rng):
+        """The optimizer/update pattern: in-place under no_grad works."""
+        x = t(np.array([4.0, 9.0], np.float32))
+        x.stop_gradient = False
+        with paddle.no_grad():
+            r = paddle.sqrt_(x)
+        assert r is x
+        np.testing.assert_allclose(n(x), [2.0, 3.0])
+
+
 class TestCompleteness:
     def test_every_export_resolves(self):
         missing = [name for name in longtail2.__all__

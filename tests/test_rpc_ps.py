@@ -1,0 +1,141 @@
+"""P2P RPC + parameter-server mode, in REAL processes (SURVEY A18 + A17/
+C20 — the last recorded capability gaps; reference:
+paddle/fluid/distributed/rpc/ rpc_agent + distributed/ps/ dense/sparse
+tables via fleet PS mode). Pattern follows test_multihost.py: subprocess
+workers rendezvous over localhost."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _run_world(script, n, port, timeout=120, extra_env=None):
+    procs = []
+    for rank in range(n):
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""
+        env["RPC_RANK"] = str(rank)
+        env["RPC_WORLD"] = str(n)
+        env["RPC_PORT"] = str(port)
+        env.update(extra_env or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", script.replace("__REPO__", REPO)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out.decode())
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rank failed:\n{out}"
+    return outs
+
+
+RPC_SCRIPT = textwrap.dedent("""
+    import os, sys, operator
+    sys.path.insert(0, "__REPO__")
+    from paddle_tpu.distributed import rpc
+
+    rank = int(os.environ["RPC_RANK"])
+    world = int(os.environ["RPC_WORLD"])
+    ep = "127.0.0.1:" + os.environ["RPC_PORT"]
+    me = rpc.init_rpc(f"worker{rank}", rank, world, ep)
+    assert me.name == f"worker{rank}" and me.rank == rank
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+    if rank == 0:
+        # sync call executes on the peer
+        assert rpc.rpc_sync("worker1", operator.add, (2, 3)) == 5
+        # async returns a future with paddle's .wait()
+        fut = rpc.rpc_async("worker1", operator.mul, (6, 7))
+        assert fut.wait() == 42
+        # callee exceptions propagate
+        try:
+            rpc.rpc_sync("worker1", operator.truediv, (1, 0))
+        except ZeroDivisionError:
+            print("EXC_OK")
+        else:
+            raise AssertionError("expected ZeroDivisionError")
+    rpc.shutdown()
+    print("RPC_DONE", rank)
+""")
+
+
+PS_SCRIPT = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    sys.path.insert(0, "__REPO__")
+    from paddle_tpu.distributed import ps
+
+    rank = int(os.environ["RPC_RANK"])
+    world = int(os.environ["RPC_WORLD"])
+    ep = "127.0.0.1:" + os.environ["RPC_PORT"]
+    role = "PSERVER" if rank == 0 else "TRAINER"
+    name = "ps0" if rank == 0 else f"trainer{rank}"
+    ps.init_ps(name, rank, world, ep, role=role, lr=0.1, sparse_dim=4)
+    if ps.is_server():
+        # server idles; shutdown barriers on everyone
+        ps.shutdown()
+        print("PS_SERVER_DONE")
+    else:
+        target = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+        ps.register_dense("w", np.zeros(4, np.float32))
+        for _ in range(60):
+            w = ps.pull_dense("w")
+            ps.push_dense("w", w - target)      # grad of 0.5*|w-t|^2
+        ps.barrier()
+        w = ps.pull_dense("w")
+        err = float(np.abs(w - target).max())
+        assert err < 0.05, (w, target, err)
+        # sparse: rank-disjoint id ranges keep the arithmetic exact while
+        # both trainers hammer the same table concurrently
+        ids = np.array([rank * 100, rank * 100 + 1, rank * 100 + 2],
+                       np.int64)
+        rows = ps.pull_sparse("emb", ids)
+        assert rows.shape == (3, 4)
+        ps.push_sparse("emb", ids, np.ones((3, 4), np.float32), sync=True)
+        rows2 = ps.pull_sparse("emb", ids)
+        np.testing.assert_allclose(rows2, rows - 0.1, rtol=1e-5, atol=1e-6)
+        # duplicate ids in one push accumulate (scatter-add semantics)
+        dup = np.array([ids[0], ids[0]], np.int64)
+        before = ps.pull_sparse("emb", [ids[0]])[0]
+        ps.push_sparse("emb", dup, np.ones((2, 4), np.float32), sync=True)
+        after = ps.pull_sparse("emb", [ids[0]])[0]
+        np.testing.assert_allclose(after, before - 0.2, rtol=1e-5,
+                                   atol=1e-6)
+        stats = ps.barrier()
+        assert "emb" in stats["sparse_rows"]
+        assert stats["sparse_rows"]["emb"] >= 3  # lazy rows materialized
+        ps.shutdown()
+        print("PS_TRAINER_DONE", rank)
+""")
+
+
+def test_rpc_two_workers():
+    outs = _run_world(RPC_SCRIPT, 2, _free_port())
+    assert "EXC_OK" in outs[0]
+    assert all("RPC_DONE" in o for o in outs)
+
+
+def test_ps_one_server_two_trainers():
+    outs = _run_world(PS_SCRIPT, 3, _free_port())
+    assert "PS_SERVER_DONE" in outs[0]
+    assert "PS_TRAINER_DONE 1" in outs[1]
+    assert "PS_TRAINER_DONE 2" in outs[2]

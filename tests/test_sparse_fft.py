@@ -128,6 +128,23 @@ class TestSparseMatmulGrads:
         np.testing.assert_allclose(n(out.to_dense()),
                                    [[0.0, 11.0], [2.0, 20.0]])
 
+    def test_shape_mismatches_raise(self, coo, rng):
+        """code-review r4: XLA's clamped gather must never turn a shape
+        error into silently wrong numbers."""
+        sp, _, _ = coo  # [4, 5]
+        with pytest.raises(ValueError, match="incompatible"):
+            sparse.matmul(sp, Tensor(np.ones((3, 2), np.float32)))
+        with pytest.raises(ValueError, match="incompatible"):
+            sparse.matmul(Tensor(np.ones((2, 3), np.float32)), sp)
+        with pytest.raises(ValueError, match="mask shape"):
+            sparse.masked_matmul(Tensor(np.ones((4, 7), np.float32)),
+                                 Tensor(np.ones((6, 5), np.float32)), sp)
+        other = sparse.sparse_coo_tensor(
+            np.array([[0], [0]], np.int32),
+            np.array([1.0], np.float32), [1, 2])
+        with pytest.raises(ValueError, match="must match"):
+            sparse.add(sp, other)
+
     def test_csr_add_csr_stays_csr(self, rng):
         """code-review r4: CSR+CSR must return CSR, not fall to dense."""
         a = sparse.sparse_csr_tensor(
@@ -141,16 +158,6 @@ class TestSparseMatmulGrads:
         np.testing.assert_allclose(n(out.to_dense()),
                                    [[1.0, 10.0], [0.0, 22.0]])
         np.testing.assert_array_equal(n(out.crows()), [0, 2, 3])
-
-    def test_hfftn_with_s_only(self, rng):
-        """code-review r4: s given with axes=None must use the LAST
-        len(s) axes (fftn-family convention)."""
-        c = (rng.standard_normal((3, 4, 6))
-             + 1j * rng.standard_normal((3, 4, 6))).astype(np.complex64)
-        got = n(fft.hfftn(Tensor(c), s=(4, 10)))
-        want = np.fft.hfft(np.fft.fft(c, n=4, axis=-2), n=10, axis=-1)
-        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
-
 
 class TestFFT:
     def test_forward_matches_numpy(self, rng):
@@ -217,6 +224,16 @@ class TestFFT:
         # d(sum(irfft(rfft(x))))/dx == ones (identity map)
         np.testing.assert_allclose(n(t.grad), np.ones(8), rtol=1e-4,
                                    atol=1e-4)
+
+    def test_hfftn_with_s_only(self, rng):
+        """code-review r4: s given with axes=None must use the LAST
+        len(s) axes (fftn-family convention)."""
+        c = (rng.standard_normal((3, 4, 6))
+             + 1j * rng.standard_normal((3, 4, 6))).astype(np.complex64)
+        got = n(fft.hfftn(Tensor(c), s=(4, 10)))
+        want = np.fft.hfft(np.fft.fft(c, n=4, axis=-2), n=10, axis=-1)
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
 
     def test_freq_and_shift(self):
         np.testing.assert_allclose(n(fft.fftfreq(8, 0.5)),

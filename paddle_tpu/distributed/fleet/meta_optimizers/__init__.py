@@ -2,6 +2,7 @@
 meta_optimizers/ — the static-graph rewrites are subsumed by compiled SPMD;
 what survives is the dygraph hybrid optimizer glue)."""
 from .dygraph_optimizer import (  # noqa: F401
+    DGCMomentumOptimizer,
     DygraphShardingOptimizer,
     GradientMergeOptimizer,
     LocalSGDOptimizer,
@@ -15,4 +16,5 @@ __all__ = [
     "DygraphShardingOptimizer",
     "GradientMergeOptimizer",
     "LocalSGDOptimizer",
+    "DGCMomentumOptimizer",
 ]

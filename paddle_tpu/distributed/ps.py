@@ -46,8 +46,12 @@ class ParameterServer:
         self.lr = float(lr)
         self.optimizer = optimizer
         self.sparse_dim = int(sparse_dim)
+        # ONE generator for the server's lifetime — re-seeding per row
+        # would initialize every embedding row identically (the symmetric
+        # init failure recommender embeddings must avoid)
+        self._init_rng = np.random.default_rng(0)
         self.initializer = initializer or (
-            lambda shape: np.random.default_rng(0).standard_normal(
+            lambda shape: self._init_rng.standard_normal(
                 shape).astype(np.float32) * 0.01)
         self._dense: Dict[str, np.ndarray] = {}
         self._dense_acc: Dict[str, np.ndarray] = {}

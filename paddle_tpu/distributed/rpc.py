@@ -89,7 +89,15 @@ class _Handler(socketserver.BaseRequestHandler):
                 result = ("ok", fn(*args, **(kwargs or {})))
             except Exception as e:  # ship the callee's exception back
                 result = ("err", e)
-            _send_msg(self.request, pickle.dumps(result))
+            try:
+                payload = pickle.dumps(result)
+            except Exception as e:
+                # unpicklable return/exception: tell the caller WHAT
+                # happened instead of dropping the connection
+                payload = pickle.dumps(("err", RuntimeError(
+                    f"rpc callee result not picklable "
+                    f"({type(result[1]).__name__}): {e}")))
+            _send_msg(self.request, payload)
         except (ConnectionError, OSError):
             pass
 
@@ -112,12 +120,24 @@ def init_rpc(name: str, rank: int, world_size: int,
             socket.gethostbyname(socket.gethostname()))
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
-        store.set(f"rpc/{rank}",
-                  pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
-        workers = {}
-        for r in range(world_size):
-            info = pickle.loads(bytes(store.get(f"rpc/{r}", timeout=60)))
-            workers[info.name] = info
+        try:
+            store.set(f"rpc/{rank}",
+                      pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
+            workers = {}
+            for r in range(world_size):
+                info = pickle.loads(
+                    bytes(store.get(f"rpc/{r}", timeout=60)))
+                workers[info.name] = info
+        except Exception:
+            # rendezvous failed (a peer never joined): release the bound
+            # socket + thread so a retry doesn't leak one per attempt
+            server.shutdown()
+            server.server_close()
+            try:
+                store.close()
+            except Exception:
+                pass
+            raise
         _agent.name, _agent.rank = name, rank
         _agent.world_size = world_size
         _agent.workers = workers

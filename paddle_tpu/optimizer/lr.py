@@ -306,6 +306,11 @@ class CosineAnnealingWarmRestarts(LRScheduler):
                  last_epoch=-1, verbose=False):
         if T_0 <= 0 or T_mult < 1:
             raise ValueError("T_0 must be positive and T_mult >= 1")
+        if int(T_mult) != T_mult:
+            # the closed-form restart index assumes integer periods (so
+            # does the reference's recurrence)
+            raise TypeError("T_mult must be an integer")
+        T_mult = int(T_mult)
         self.T_0 = T_0
         self.T_mult = T_mult
         self.eta_min = eta_min

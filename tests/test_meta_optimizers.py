@@ -1,14 +1,93 @@
-"""LocalSGD + ASP meta-optimizer parity (SURVEY.md C16; reference:
-fleet/meta_optimizers/localsgd_optimizer.py + asp_optimizer.py /
-paddle.incubate.asp)."""
+"""LocalSGD + ASP + DGC meta-optimizer parity (SURVEY.md C16 / A3.x;
+reference: fleet/meta_optimizers/localsgd_optimizer.py + asp_optimizer.py
+/ paddle.incubate.asp + DGC dgc_momentum_op)."""
 import jax.numpy as jnp
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import nn, optimizer
-from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGDOptimizer
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCMomentumOptimizer, LocalSGDOptimizer)
 from paddle_tpu.incubate import asp
+
+
+class TestDGC:
+    def _param(self, vals):
+        return paddle.framework.Parameter(np.asarray(vals, np.float32))
+
+    def test_topk_selection_and_residual(self):
+        """Only the top-(1-sparsity) of |v| is applied; the rest stays as
+        local residual and is delivered by a later step (nothing lost)."""
+        w = self._param(np.zeros(4))
+        inner = optimizer.SGD(learning_rate=1.0, parameters=[w])
+        dgc = DGCMomentumOptimizer(inner, momentum=0.0,
+                                   rampup_begin_step=0, sparsity=(0.75,),
+                                   sync=False)
+        g = np.array([0.1, -4.0, 0.2, 0.3], np.float32)
+        w.grad = paddle.to_tensor(g)
+        dgc.step()
+        # 75% sparsity on 4 elems -> 1 sent: the largest |v| (index 1)
+        np.testing.assert_allclose(np.asarray(w), [0.0, 4.0, 0.0, 0.0],
+                                   rtol=1e-6)
+        # same gradient again: v = residual + g = [0.2,-4,0.4,0.6];
+        # index 1 still dominates and is re-sent
+        w.grad = paddle.to_tensor(g)
+        dgc.step()
+        np.testing.assert_allclose(np.asarray(w), [0.0, 8.0, 0.0, 0.0],
+                                   rtol=1e-5)
+        # zero gradient: the residual itself is delivered (top |v| = 0.6
+        # at index 3, applied as w -= v) — compression delays, never drops
+        w.grad = paddle.to_tensor(np.zeros(4, np.float32))
+        dgc.step()
+        np.testing.assert_allclose(np.asarray(w), [0.0, 8.0, 0.0, -0.6],
+                                   rtol=1e-5)
+
+    def test_nothing_lost_over_time(self):
+        """With a constant gradient, total applied update over many steps
+        approaches steps*g — compression delays, never drops."""
+        w = self._param(np.zeros(8))
+        inner = optimizer.SGD(learning_rate=1.0, parameters=[w])
+        dgc = DGCMomentumOptimizer(inner, momentum=0.0, sparsity=(0.875,),
+                                   sync=False)
+        g = np.linspace(0.1, 0.8, 8).astype(np.float32)
+        n_steps = 40
+        for _ in range(n_steps):
+            w.grad = paddle.to_tensor(g)
+            dgc.step()
+        total = -np.asarray(w)  # SGD: w -= sum(applied)
+        # residuals hold at most a few steps' worth per slot
+        np.testing.assert_allclose(total, n_steps * g, rtol=0.35)
+
+    def test_rampup_schedule(self):
+        w = self._param(np.zeros(4))
+        inner = optimizer.SGD(learning_rate=1.0, parameters=[w])
+        dgc = DGCMomentumOptimizer(inner, rampup_begin_step=2,
+                                   rampup_step=2,
+                                   sparsity=(0.5, 0.75), sync=False)
+        seen = []
+        for _ in range(7):
+            seen.append(dgc.current_sparsity())
+            w.grad = paddle.to_tensor(np.ones(4, np.float32))
+            dgc.step()
+        assert seen == [0.0, 0.0, 0.5, 0.5, 0.75, 0.75, 0.75]
+
+    def test_momentum_factor_masking(self):
+        """Momentum of SENT coordinates resets (the DGC correction);
+        unsent coordinates keep accumulating velocity."""
+        w = self._param(np.zeros(2))
+        inner = optimizer.SGD(learning_rate=1.0, parameters=[w])
+        dgc = DGCMomentumOptimizer(inner, momentum=0.5, sparsity=(0.5,),
+                                   sync=False)
+        g = np.array([1.0, 0.4], np.float32)
+        w.grad = paddle.to_tensor(g)
+        dgc.step()   # sends index 0 (v=1.0), residual v=[0, 0.4]
+        np.testing.assert_allclose(np.asarray(w), [-1.0, 0.0], rtol=1e-6)
+        w.grad = paddle.to_tensor(g)
+        dgc.step()
+        # index 0: u reset -> u=1.0, v=1.0; index 1: u=0.5*0.4+0.4=0.6,
+        # v=0.4+0.6=1.0 -> tie at threshold sends BOTH (|v| >= thr)
+        np.testing.assert_allclose(np.asarray(w), [-2.0, -1.0], rtol=1e-5)
 
 
 class TestLocalSGD:

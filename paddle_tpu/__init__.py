@@ -146,3 +146,9 @@ from .ops.linalg import (  # noqa: F401,E402
 )
 from .nn.functional.activation import log_softmax  # noqa: F401,E402
 from .ops.math import bincount, einsum, nonzero, unique  # noqa: F401,E402
+
+# attach the functional tensor API as Tensor methods (reference:
+# python/paddle/tensor/__init__.py tensor_method_func monkey-patching)
+from .framework.tensor_methods import register_tensor_methods  # noqa: E402
+
+register_tensor_methods()

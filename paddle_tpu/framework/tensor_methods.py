@@ -1,0 +1,78 @@
+"""Attach the tensor-API functions as Tensor METHODS (reference:
+``python/paddle/tensor/__init__.py`` ``tensor_method_func`` — paddle
+monkey-patches its functional tensor API onto the Tensor class so
+``x.cholesky()``, ``x.masked_fill(...)``, ``x.sqrt_()`` etc. all work).
+
+Registration is mechanical: every name in the tensor-op modules'
+``__all__`` whose first parameter is the tensor itself is set directly on
+``Tensor`` (plain functions become bound methods via the descriptor
+protocol, so signatures/docs survive for introspection), EXCEPT the
+names in ``_EXCLUDE`` (creation ops, list-first ops, string-first ops,
+framework utilities). Existing hand-written members always win — this
+only fills gaps — with one dual-role exception: ``Tensor.view`` gains
+the functional form's dtype-bitcast role on top of the hand-written
+shape role (matching the reference's dual-role ``paddle.view``)."""
+from __future__ import annotations
+
+import inspect
+
+# not tensor-first (or not methods in the reference)
+_EXCLUDE = {
+    # creation / generator-style
+    "linspace", "logspace", "eye", "empty", "full", "ones", "zeros",
+    "rand", "randn", "randint", "randperm", "uniform", "normal",
+    "arange", "tril_indices", "triu_indices", "vander", "to_tensor",
+    "binomial", "standard_gamma", "log_normal", "randint_like",
+    # list-first / multi-input
+    "add_n", "multi_dot", "broadcast_tensors", "meshgrid", "einsum",
+    "block_diag", "cartesian_prod", "stack", "concat", "hstack",
+    "vstack", "dstack", "column_stack", "row_stack", "multiplex",
+    # framework utilities
+    "broadcast_shape", "finfo", "iinfo", "set_printoptions",
+    "set_grad_enabled", "get_rng_state", "set_rng_state",
+    "create_parameter", "complex", "polar",
+}
+
+
+def register_tensor_methods():
+    from .. import ops
+    from .tensor import Tensor
+
+    added = []
+    for mod in (ops.math, ops.manipulation, ops.creation, ops.linalg,
+                ops.longtail, ops.longtail2):
+        for name in mod.__all__:
+            if name in _EXCLUDE or hasattr(Tensor, name):
+                continue
+            fn = getattr(mod, name, None)
+            if (not callable(fn) or inspect.isclass(fn)
+                    or inspect.ismodule(fn)):
+                continue
+            # a plain function set on the class IS the method (descriptor
+            # protocol binds self as the first arg) — signature and
+            # docstring stay intact for help()/IDE introspection
+            setattr(Tensor, name, fn)
+            added.append(name)
+
+    # dual-role view: the hand-written method handles shapes; route
+    # dtype arguments to the functional bitcast form like the reference
+    _shape_view = Tensor.view
+
+    def view(self, shape_or_dtype):
+        if isinstance(shape_or_dtype, (list, tuple)):
+            return _shape_view(self, shape_or_dtype)
+        from ..ops.longtail2 import view as _functional_view
+
+        return _functional_view(self, shape_or_dtype)
+
+    view.__doc__ = ("Reshape view (list/tuple) or dtype-bitcast "
+                    "reinterpret (dtype) — paddle's dual-role "
+                    "Tensor.view.")
+    Tensor.view = view
+
+    # small manual aliases paddle exposes
+    if not hasattr(Tensor, "ndimension") and hasattr(Tensor, "dim"):
+        Tensor.ndimension = Tensor.dim
+    if not hasattr(Tensor, "cpu"):
+        Tensor.cpu = lambda self: self  # host framework: already "cpu"
+    return added

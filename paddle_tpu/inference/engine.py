@@ -668,10 +668,15 @@ def bench_engine_decode(cfg, on_tpu):
 
         mixed_requests()
         eng.run()                      # warmup: compiles every bucket
-        reqs = mixed_requests()
-        t0 = time.perf_counter()
-        eng.run()
-        dt = time.perf_counter() - t0
-        total = sum(len(r.tokens) for r in reqs)
-        out[f"{key}_serve_tokens_per_sec"] = round(total / dt, 1)
+        # the serve loop crosses ~10 host sync points, so single-shot
+        # timing rides the tunnel's RTT jitter — median of 3 runs
+        rates = []
+        for _ in range(3 if on_tpu else 1):
+            reqs = mixed_requests()
+            t0 = time.perf_counter()
+            eng.run()
+            dt = time.perf_counter() - t0
+            rates.append(sum(len(r.tokens) for r in reqs) / dt)
+        out[f"{key}_serve_tokens_per_sec"] = round(
+            sorted(rates)[len(rates) // 2], 1)
     return out

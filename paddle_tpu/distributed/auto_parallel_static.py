@@ -61,10 +61,14 @@ class Engine:
     auto-parallel entry point, lowered to one pjit'd SPMD step."""
 
     def __init__(self, model, loss=None, optimizer=None, metrics=None,
-                 strategy=None, mesh=None):
+                 strategy=None, mesh=None, auto_lr_step=True):
         self.model = model
         self.loss = loss
         self.optimizer = optimizer
+        # Engine.fit owns per-batch LRScheduler.step() like the reference's
+        # static Engine; a user who drives the scheduler themselves must
+        # pass auto_lr_step=False or the schedule advances twice per batch.
+        self.auto_lr_step = auto_lr_step
         self.metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics is not None else [])
         self.strategy = strategy
@@ -210,10 +214,12 @@ class Engine:
                     self.history.append(lval)
                     if verbose and self._step_count % log_freq == 0:
                         print(f"step {self._step_count}: loss {lval:.5f}")
-                    sched_step = getattr(
-                        getattr(self.optimizer, "_lr", None), "step", None)
-                    if callable(sched_step):
-                        sched_step()
+                    if self.auto_lr_step:
+                        sched_step = getattr(
+                            getattr(self.optimizer, "_lr", None), "step",
+                            None)
+                        if callable(sched_step):
+                            sched_step()
         self._writeback()
         return self.history
 

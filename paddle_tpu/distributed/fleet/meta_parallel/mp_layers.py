@@ -29,6 +29,21 @@ __all__ = [
     "ParallelCrossEntropy", "parallel_cross_entropy_shardmap",
 ]
 
+# ParallelCrossEntropy must know whether it is being traced inside an
+# already-manual (shard_map) region to avoid a rejected nested shard_map.
+# Probe the PUBLIC detection API once at import and hard-fail with a clear
+# message if the installed jax dropped it (ADVICE r3/r4: no private-API
+# probe, no silent degradation on drift).
+if not (hasattr(jax.sharding, "get_abstract_mesh")
+        and hasattr(jax.sharding, "AxisType")):  # pragma: no cover
+    raise ImportError(
+        "paddle_tpu.distributed.fleet.meta_parallel.mp_layers requires "
+        "jax.sharding.get_abstract_mesh and jax.sharding.AxisType (public "
+        f"since jax 0.4.35; installed jax {jax.__version__} lacks them). "
+        "ParallelCrossEntropy's manual-region detection cannot work — "
+        "install a compatible jax rather than risking a silent fallback "
+        "to full-vocab-logits cross entropy.")
+
 
 class VocabParallelEmbedding(nn.Layer):
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
@@ -163,14 +178,16 @@ class ParallelCrossEntropy(nn.Layer):
 
     @staticmethod
     def _inside_manual_region() -> bool:
-        try:
-            from jax._src import mesh as _mesh_lib
+        cur = jax.sharding.get_abstract_mesh()
+        return bool(cur is not None and getattr(cur, "axis_types", None)
+                    and jax.sharding.AxisType.Manual in cur.axis_types)
 
-            cur = _mesh_lib.get_abstract_mesh()
-            return bool(cur is not None and getattr(cur, "axis_types", None)
-                        and any("Manual" in str(t) for t in cur.axis_types))
-        except Exception:
-            return False
+    @classmethod
+    def reset_fallback_count(cls):
+        """Zero the fallback counter (for monitoring / between test
+        phases, so one legitimate fallback early in a long-lived process
+        doesn't permanently trip later counter==0 assertions)."""
+        cls.fallback_count = 0
 
     def forward(self, input, label):
         from ....framework.tensor import Tensor, apply_op
@@ -198,14 +215,18 @@ class ParallelCrossEntropy(nn.Layer):
         try:
             return apply_op(fn, input if isinstance(input, Tensor)
                             else Tensor(input), lbl)
-        except Exception as e:
-            # _inside_manual_region probes a private jax API; if that
-            # detection ever drifts (ADVICE r3), the nested shard_map
-            # fails at trace time — degrade to plain CE (GSPMD keeps the
-            # logits' mp sharding) rather than breaking the loss path.
-            # Warn loudly AND count: plain CE is numerically identical, so
-            # without the counter a permanent silent fallback would pass
-            # every correctness test while losing the no-full-vocab-logits
+        except (ValueError, TypeError, NotImplementedError) as e:
+            # These are the trace-time error types a rejected nested
+            # shard_map raises if the manual-region detection ever drifts.
+            # Degrade to plain CE (GSPMD keeps the logits' mp sharding)
+            # rather than breaking the loss path — but ONLY for those
+            # types: genuine user errors (bad label shape/dtype raise
+            # their own ValueError inside fn, true, but those reproduce
+            # identically under plain CE and surface there) must not be
+            # swallowed silently, hence the narrow clause + loud warning.
+            # Count as well: plain CE is numerically identical, so without
+            # the counter a permanent silent fallback would pass every
+            # correctness test while losing the no-full-vocab-logits
             # property (tests assert the counter stays zero).
             import warnings
 

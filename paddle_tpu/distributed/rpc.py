@@ -103,21 +103,44 @@ class _Handler(socketserver.BaseRequestHandler):
 
 
 def init_rpc(name: str, rank: int, world_size: int,
-             master_endpoint: str = "127.0.0.1:29500"):
+             master_endpoint: str = "127.0.0.1:29500",
+             bind_address: Optional[str] = None):
     """Join the RPC world. ``master_endpoint`` hosts the rendezvous store
-    (rank 0 starts it)."""
+    (rank 0 starts it).
+
+    The agent's server binds to ``bind_address`` when given; otherwise it
+    binds to the interface it advertises (loopback for a local-master run,
+    the host's resolved IP otherwise) — never to all interfaces, since the
+    handler executes pickled payloads and must only be reachable over the
+    cluster interconnect the trust model covers.
+    """
     with _lock:
         if _agent.server is not None:
             raise RuntimeError("init_rpc called twice")
         host, port_s = master_endpoint.rsplit(":", 1)
         store = TCPStore(host, int(port_s), is_master=(rank == 0),
                          world_size=world_size)
-        server = socketserver.ThreadingTCPServer(
-            ("0.0.0.0", 0), _Handler, bind_and_activate=True)
+        try:
+            if bind_address:
+                my_ip = bind_address
+            elif host in ("127.0.0.1", "localhost"):
+                my_ip = "127.0.0.1"
+            else:
+                my_ip = socket.gethostbyname(socket.gethostname())
+            server = socketserver.ThreadingTCPServer(
+                (my_ip, 0), _Handler, bind_and_activate=True)
+        except Exception:
+            # hostname resolution or bind can fail (gaierror, an
+            # EADDRNOTAVAIL bind_address): don't leak the rendezvous
+            # store — rank 0 holds the master listener on the endpoint
+            # port and a corrected retry would hit EADDRINUSE
+            try:
+                store.close()
+            except Exception:
+                pass
+            raise
         server.daemon_threads = True
         my_port = server.server_address[1]
-        my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else (
-            socket.gethostbyname(socket.gethostname()))
         t = threading.Thread(target=server.serve_forever, daemon=True)
         t.start()
         try:

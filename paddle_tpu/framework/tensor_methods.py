@@ -34,6 +34,35 @@ _EXCLUDE = {
 }
 
 
+# first-parameter names under which the tensor-op modules take the tensor
+# input (the dual-role ones — condition/sorted_sequence/y/index — are
+# tensor-first in the reference's method form too); anything else (a
+# shape, a string, a callable) must not become a Tensor method even if it
+# slips past _EXCLUDE
+_TENSOR_PARAM_NAMES = {"x", "input", "a", "tensor", "self", "xs",
+                       "condition", "sorted_sequence", "y", "index"}
+
+
+def _tensor_first(fn) -> bool:
+    """True when ``fn``'s first parameter is positionally the tensor input
+    (the registration criterion the module docstring states), judged from
+    its signature rather than from ``_EXCLUDE`` staying in sync."""
+    try:
+        params = list(inspect.signature(fn).parameters.values())
+    except (TypeError, ValueError):
+        return False
+    if not params:
+        return False
+    p = params[0]
+    if p.kind == p.VAR_POSITIONAL:
+        # *inputs style (atleast_1d/2d/3d): binding self as inputs[0] is
+        # exactly the reference's method semantics
+        return p.name == "inputs"
+    if p.kind not in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+        return False
+    return p.name in _TENSOR_PARAM_NAMES
+
+
 def register_tensor_methods():
     from .. import ops
     from .tensor import Tensor
@@ -47,6 +76,8 @@ def register_tensor_methods():
             fn = getattr(mod, name, None)
             if (not callable(fn) or inspect.isclass(fn)
                     or inspect.ismodule(fn)):
+                continue
+            if not _tensor_first(fn):
                 continue
             # a plain function set on the class IS the method (descriptor
             # protocol binds self as the first arg) — signature and

@@ -89,6 +89,54 @@ class TestEngineTwin:
         w = np.asarray(model[0].weight)
         assert np.all(np.isfinite(w))
 
+    def test_fit_with_lr_scheduler_matches_dynamic(self):
+        """Engine.fit owns per-batch scheduler stepping (auto_lr_step=True,
+        the default); a dynamic-path twin that steps the scheduler itself
+        per batch must see the same losses/weights — i.e. the schedule
+        advances exactly once per batch, never twice (ADVICE r4)."""
+        data = _data(n_batches=5, seed=7)
+        m1 = _mlp(seed=9)
+        sched1 = optimizer.lr.StepDecay(learning_rate=0.2, step_size=2,
+                                        gamma=0.5)
+        opt1 = optimizer.SGD(learning_rate=sched1,
+                             parameters=m1.parameters())
+        loss1 = nn.CrossEntropyLoss()
+        dyn_losses = []
+        for x, y in data:
+            out = m1(Tensor(x))
+            l = loss1(out, Tensor(y))
+            dyn_losses.append(float(np.asarray(l)))
+            l.backward()
+            opt1.step()
+            opt1.clear_grad()
+            sched1.step()
+        m2 = _mlp(seed=9)
+        sched2 = optimizer.lr.StepDecay(learning_rate=0.2, step_size=2,
+                                        gamma=0.5)
+        eng = Engine(m2, loss=nn.CrossEntropyLoss(),
+                     optimizer=optimizer.SGD(learning_rate=sched2,
+                                             parameters=m2.parameters()))
+        hist = eng.fit(data, epochs=1)
+        np.testing.assert_allclose(hist, dyn_losses, rtol=1e-5, atol=1e-6)
+        assert sched2.last_epoch == sched1.last_epoch
+        for (n1, p1), (_, p2) in zip(m1.named_parameters(),
+                                     m2.named_parameters()):
+            np.testing.assert_allclose(np.asarray(p1), np.asarray(p2),
+                                       rtol=1e-5, atol=1e-6, err_msg=n1)
+
+    def test_fit_auto_lr_step_off_leaves_schedule(self):
+        """auto_lr_step=False: Engine.fit must not advance the scheduler."""
+        sched = optimizer.lr.StepDecay(learning_rate=0.1, step_size=1,
+                                       gamma=0.5)
+        m = _mlp(seed=4)
+        eng = Engine(m, loss=nn.CrossEntropyLoss(),
+                     optimizer=optimizer.SGD(learning_rate=sched,
+                                             parameters=m.parameters()),
+                     auto_lr_step=False)
+        before = sched.last_epoch
+        eng.fit(_data(n_batches=3), epochs=1)
+        assert sched.last_epoch == before
+
     def test_fit_requires_loss_and_optimizer(self):
         eng = Engine(_mlp())
         with pytest.raises(ValueError, match="loss and optimizer"):

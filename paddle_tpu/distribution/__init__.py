@@ -15,7 +15,18 @@ from ..framework.tensor import Tensor, apply_op
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "Exponential", "Laplace", "LogNormal", "kl_divergence",
-           "register_kl"]
+           "register_kl",
+           # families tail (r5)
+           "Beta", "Gamma", "Dirichlet", "Multinomial", "Binomial",
+           "Poisson", "Geometric", "Gumbel", "Cauchy", "StudentT",
+           "MultivariateNormal", "ContinuousBernoulli", "Independent",
+           "TransformedDistribution", "ExponentialFamily", "ChiSquared",
+           # transforms (r5)
+           "Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform",
+           "IndependentTransform"]
 
 
 def _arr(x):
@@ -271,3 +282,16 @@ def _kl_categorical(p, q):
     return Tensor._wrap(jnp.sum(
         pp * (jax.nn.log_softmax(p.logits, -1)
               - jax.nn.log_softmax(q.logits, -1)), axis=-1))
+
+
+# families + transforms tail live in submodules; import AFTER the base
+# machinery so their register_kl decorators land in this registry
+from .transform import (  # noqa: E402
+    Transform, AbsTransform, AffineTransform, ChainTransform,
+    ExpTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform,
+    TanhTransform, IndependentTransform)
+from .families import (  # noqa: E402
+    Beta, Gamma, Dirichlet, Multinomial, Binomial, Poisson, Geometric,
+    Gumbel, Cauchy, StudentT, MultivariateNormal, ContinuousBernoulli,
+    Independent, TransformedDistribution, ExponentialFamily, ChiSquared)

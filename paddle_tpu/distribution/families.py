@@ -1,0 +1,578 @@
+"""Distribution-family tail (VERDICT r4 #7; reference:
+python/paddle/distribution/ — beta.py, gamma.py, dirichlet.py,
+multinomial.py, binomial.py, poisson.py, geometric.py, gumbel.py,
+cauchy.py, student_t.py, multivariate_normal.py, independent.py,
+transformed_distribution.py, continuous_bernoulli.py).
+
+jax.random-backed sampling; log_prob/entropy as taped ops so the score
+terms differentiate. KL pairs registered at the bottom."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy import special as jsp
+
+from ..framework import random as _random
+from ..framework.tensor import Tensor, apply_op
+from . import (Distribution, Normal, Exponential, Laplace, Bernoulli,
+               Categorical, register_kl, _arr)
+from .transform import ChainTransform, Transform
+
+__all__ = ["Beta", "Gamma", "Dirichlet", "Multinomial", "Binomial",
+           "Poisson", "Geometric", "Gumbel", "Cauchy", "StudentT",
+           "MultivariateNormal", "ContinuousBernoulli", "Independent",
+           "TransformedDistribution", "ExponentialFamily", "ChiSquared"]
+
+
+class ExponentialFamily(Distribution):
+    """Marker base (reference: paddle.distribution.ExponentialFamily —
+    enables the Bregman-divergence generic entropy; our families override
+    entropy directly, so this is the classification hook only)."""
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _arr(alpha)
+        self.beta = _arr(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.beta(
+            _random.op_key(), self.alpha, self.beta, shape))
+
+    def log_prob(self, value):
+        a, b = self.alpha, self.beta
+        return apply_op(
+            lambda v: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - jsp.betaln(a, b), value)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return Tensor._wrap(
+            jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+            - (b - 1) * jsp.digamma(b)
+            + (a + b - 2) * jsp.digamma(a + b))
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor._wrap(self.alpha * self.beta / (s * s * (s + 1)))
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _arr(concentration)
+        self.rate = _arr(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.gamma(
+            _random.op_key(), self.concentration, shape) / self.rate)
+
+    def log_prob(self, value):
+        a, r = self.concentration, self.rate
+        return apply_op(
+            lambda v: a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+            - jsp.gammaln(a), value)
+
+    def entropy(self):
+        a, r = self.concentration, self.rate
+        return Tensor._wrap(a - jnp.log(r) + jsp.gammaln(a)
+                            + (1 - a) * jsp.digamma(a))
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor._wrap(self.concentration / self.rate ** 2)
+
+
+class ChiSquared(Gamma):
+    def __init__(self, df):
+        df = _arr(df)
+        super().__init__(df / 2.0, jnp.full_like(df, 0.5))
+        self.df = df
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _arr(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.dirichlet(
+            _random.op_key(), self.concentration, shape))
+
+    def log_prob(self, value):
+        a = self.concentration
+        norm = jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(jnp.sum(a, -1))
+        return apply_op(
+            lambda v: jnp.sum((a - 1) * jnp.log(v), -1) - norm, value)
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        lnB = jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+        return Tensor._wrap(
+            lnB + (a0 - k) * jsp.digamma(a0)
+            - jnp.sum((a - 1) * jsp.digamma(a), -1))
+
+    @property
+    def mean(self):
+        return Tensor._wrap(
+            self.concentration
+            / jnp.sum(self.concentration, -1, keepdims=True))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_arr = _arr(probs)
+        p = self.probs_arr / jnp.sum(self.probs_arr, -1, keepdims=True)
+        self._p = p
+        super().__init__(p.shape[:-1], p.shape[-1:])
+
+    @property
+    def probs(self):
+        return Tensor._wrap(self._p)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self._p, 1e-30))
+        draws = jax.random.categorical(
+            _random.op_key(), logits,
+            shape=(self.total_count,) + shape)          # [n, ...]
+        k = self._p.shape[-1]
+        onehot = jax.nn.one_hot(draws, k, dtype=jnp.float32)
+        return Tensor._wrap(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        logp = jnp.log(jnp.clip(self._p, 1e-30))
+        n = self.total_count
+        return apply_op(
+            lambda v: jsp.gammaln(jnp.asarray(n + 1.0))
+            - jnp.sum(jsp.gammaln(v + 1.0), -1)
+            + jnp.sum(v * logp, -1), value)
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.total_count * self._p)
+
+    @property
+    def variance(self):
+        return Tensor._wrap(self.total_count * self._p * (1 - self._p))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = _arr(total_count)
+        self.probs_arr = _arr(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs_arr.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.binomial(
+            _random.op_key(), self.total_count, self.probs_arr, shape))
+
+    def log_prob(self, value):
+        n, p = self.total_count, jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+        return apply_op(
+            lambda v: jsp.gammaln(n + 1.0) - jsp.gammaln(v + 1.0)
+            - jsp.gammaln(n - v + 1.0) + v * jnp.log(p)
+            + (n - v) * jnp.log1p(-p), value)
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.total_count * self.probs_arr)
+
+    @property
+    def variance(self):
+        return Tensor._wrap(self.total_count * self.probs_arr
+                            * (1 - self.probs_arr))
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _arr(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.poisson(
+            _random.op_key(), self.rate, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        r = self.rate
+        return apply_op(
+            lambda v: v * jnp.log(r) - r - jsp.gammaln(v + 1.0), value)
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor._wrap(self.rate)
+
+
+class Geometric(Distribution):
+    """P(X=k) = (1-p)^k p, k in {0, 1, ...} (failures before the first
+    success — the reference's support)."""
+
+    def __init__(self, probs):
+        self.probs_arr = _arr(probs)
+        super().__init__(self.probs_arr.shape)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_random.op_key(), shape, jnp.float32,
+                               minval=1e-12)
+        return Tensor._wrap(jnp.floor(
+            jnp.log(u) / jnp.log1p(-self.probs_arr)))
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+        return apply_op(lambda v: v * jnp.log1p(-p) + jnp.log(p), value)
+
+    def entropy(self):
+        p = jnp.clip(self.probs_arr, 1e-7, 1 - 1e-7)
+        return Tensor._wrap(
+            (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p)
+
+    @property
+    def mean(self):
+        return Tensor._wrap((1 - self.probs_arr) / self.probs_arr)
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(self.loc + self.scale * jax.random.gumbel(
+            _random.op_key(), shape, jnp.float32))
+
+    def log_prob(self, value):
+        loc, sc = self.loc, self.scale
+        return apply_op(
+            lambda v: -(v - loc) / sc - jnp.exp(-(v - loc) / sc)
+            - jnp.log(sc), value)
+
+    def entropy(self):
+        return Tensor._wrap(jnp.log(self.scale) + 1.0 + 0.57721566490153)
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.loc + self.scale * 0.57721566490153)
+
+    @property
+    def variance(self):
+        return Tensor._wrap((math.pi ** 2 / 6) * self.scale ** 2)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(self.loc + self.scale * jax.random.cauchy(
+            _random.op_key(), shape, jnp.float32))
+
+    def log_prob(self, value):
+        loc, sc = self.loc, self.scale
+        return apply_op(
+            lambda v: -jnp.log(math.pi * sc)
+            - jnp.log1p(((v - loc) / sc) ** 2), value)
+
+    def entropy(self):
+        return Tensor._wrap(jnp.log(4 * math.pi * self.scale)
+                            * jnp.ones(self.batch_shape))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = _arr(df)
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(self.loc + self.scale * jax.random.t(
+            _random.op_key(), self.df, shape, jnp.float32))
+
+    def log_prob(self, value):
+        df, loc, sc = self.df, self.loc, self.scale
+        return apply_op(
+            lambda v: jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+            - 0.5 * jnp.log(df * math.pi) - jnp.log(sc)
+            - ((df + 1) / 2) * jnp.log1p(((v - loc) / sc) ** 2 / df),
+            value)
+
+    @property
+    def mean(self):
+        return Tensor._wrap(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = _arr(loc)
+        if covariance_matrix is not None:
+            self.cov = _arr(covariance_matrix)
+            self.scale_tril = jnp.linalg.cholesky(self.cov)
+        elif scale_tril is not None:
+            self.scale_tril = _arr(scale_tril)
+            self.cov = self.scale_tril @ jnp.swapaxes(
+                self.scale_tril, -2, -1)
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        return Tensor._wrap(jax.random.multivariate_normal(
+            _random.op_key(), self.loc, self.cov, shape or None))
+
+    def log_prob(self, value):
+        loc, L = self.loc, self.scale_tril
+        k = loc.shape[-1]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+
+        def fn(v):
+            diff = v - loc
+            sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                    lower=True)[..., 0]
+            return (-0.5 * jnp.sum(sol ** 2, -1) - logdet
+                    - 0.5 * k * math.log(2 * math.pi))
+
+        return apply_op(fn, value)
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        logdet = jnp.sum(jnp.log(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1)), -1)
+        return Tensor._wrap(0.5 * k * (1 + math.log(2 * math.pi)) + logdet)
+
+    @property
+    def mean(self):
+        return Tensor._wrap(self.loc)
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference: paddle.distribution.ContinuousBernoulli (lam in (0,1);
+    density C(lam) lam^x (1-lam)^(1-x) on [0, 1])."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs_arr = jnp.clip(_arr(probs), 1e-6, 1 - 1e-6)
+        self.lims = lims
+        super().__init__(self.probs_arr.shape)
+
+    def _log_norm(self):
+        lam = self.probs_arr
+        # C(lam) = 2 atanh(1-2lam) / (1-2lam), -> 2 at lam=1/2
+        near = (lam > self.lims[0]) & (lam < self.lims[1])
+        safe = jnp.where(near, 0.25, lam)
+        c = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * safe)
+                            / (1 - 2 * safe)))
+        return jnp.where(near, jnp.log(2.0), c)
+
+    def log_prob(self, value):
+        lam = self.probs_arr
+        logc = self._log_norm()
+        return apply_op(
+            lambda v: logc + v * jnp.log(lam) + (1 - v) * jnp.log1p(-lam),
+            value)
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.batch_shape
+        lam = self.probs_arr
+        u = jax.random.uniform(_random.op_key(), shape, jnp.float32,
+                               minval=1e-7, maxval=1 - 1e-7)
+        near = (lam > self.lims[0]) & (lam < self.lims[1])
+        safe = jnp.where(near, 0.25, lam)
+        x = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+             / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor._wrap(jnp.where(near, u, x))
+
+    @property
+    def mean(self):
+        lam = self.probs_arr
+        near = (lam > self.lims[0]) & (lam < self.lims[1])
+        safe = jnp.where(near, 0.25, lam)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor._wrap(jnp.where(near, 0.5, m))
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost ``reinterpreted_batch_ndims`` batch dims
+    of ``base`` as event dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.n = int(reinterpreted_batch_ndims)
+        if self.n > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_ndims exceeds the base "
+                             "distribution's batch rank")
+        cut = len(base.batch_shape) - self.n
+        super().__init__(base.batch_shape[:cut],
+                         base.batch_shape[cut:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return apply_op(
+            lambda l: jnp.sum(l, axis=tuple(range(l.ndim - self.n,
+                                                  l.ndim))), lp)
+
+    def entropy(self):
+        e = self.base.entropy()
+        return apply_op(
+            lambda l: jnp.sum(l, axis=tuple(range(l.ndim - self.n,
+                                                  l.ndim))), e)
+
+
+class TransformedDistribution(Distribution):
+    """Push ``base`` through a chain of transforms (reference:
+    paddle.distribution.TransformedDistribution — sample = T(base.sample),
+    log_prob via the change-of-variables formula)."""
+
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.chain = ChainTransform(list(transforms))
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.chain.forward(x)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        vt = value if isinstance(value, Tensor) else Tensor(value)
+        x = self.chain.inverse(vt)
+        base_lp = self.base.log_prob(x)
+        fldj = self.chain.forward_log_det_jacobian(x)
+
+        def combine(lp, ld):
+            # the chain may have reduced event dims already (event-dim
+            # transforms like StickBreaking return per-batch terms);
+            # reduce only whatever trailing dims REMAIN beyond lp's rank
+            # — never batch dims (code-review r5)
+            if ld.ndim > lp.ndim:
+                ld = jnp.sum(ld, axis=tuple(range(lp.ndim, ld.ndim)))
+            return lp - ld
+
+        return apply_op(combine, base_lp, fldj)
+
+
+# ----------------------------------------------------------------- KL pairs
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    ps = pa + pb
+    return Tensor._wrap(
+        jsp.betaln(qa, qb) - jsp.betaln(pa, pb)
+        + (pa - qa) * jsp.digamma(pa) + (pb - qb) * jsp.digamma(pb)
+        + (qa - pa + qb - pb) * jsp.digamma(ps))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    pa, pr, qa, qr = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor._wrap(
+        (pa - qa) * jsp.digamma(pa) - jsp.gammaln(pa) + jsp.gammaln(qa)
+        + qa * (jnp.log(pr) - jnp.log(qr)) + pa * (qr - pr) / pr)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    pa, qa = p.concentration, q.concentration
+    p0 = jnp.sum(pa, -1)
+    return Tensor._wrap(
+        jsp.gammaln(p0) - jnp.sum(jsp.gammaln(pa), -1)
+        - jsp.gammaln(jnp.sum(qa, -1)) + jnp.sum(jsp.gammaln(qa), -1)
+        + jnp.sum((pa - qa) * (jsp.digamma(pa)
+                               - jsp.digamma(p0)[..., None]), -1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return Tensor._wrap(jnp.log(p.rate) - jnp.log(q.rate)
+                        + q.rate / p.rate - 1.0)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    pp = jnp.clip(p.probs_arr, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.probs_arr, 1e-7, 1 - 1e-7)
+    return Tensor._wrap(pp * (jnp.log(pp) - jnp.log(qp))
+                        + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    pp = jnp.clip(p.probs_arr, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.probs_arr, 1e-7, 1 - 1e-7)
+    return Tensor._wrap(
+        jnp.log(pp) - jnp.log(qp)
+        + (1 - pp) / pp * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return Tensor._wrap(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                        - p.rate + q.rate)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    return Tensor._wrap(
+        jnp.log(q.scale) - jnp.log(p.scale)
+        + d / q.scale
+        + p.scale / q.scale * jnp.exp(-d / p.scale) - 1.0)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    k = p.loc.shape[-1]
+    qinv = jnp.linalg.inv(q.cov)
+    diff = q.loc - p.loc
+    tr = jnp.trace(qinv @ p.cov, axis1=-2, axis2=-1)
+    maha = jnp.einsum("...i,...ij,...j->...", diff, qinv, diff)
+    logdet = (jnp.linalg.slogdet(q.cov)[1]
+              - jnp.linalg.slogdet(p.cov)[1])
+    return Tensor._wrap(0.5 * (tr + maha - k + logdet))

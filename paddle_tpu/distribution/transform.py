@@ -1,0 +1,286 @@
+"""paddle.distribution.transform parity (reference:
+python/paddle/distribution/transform.py — Transform base +
+Abs/Affine/Chain/Exp/Power/Reshape/Sigmoid/Softmax/Stack/StickBreaking/
+Tanh transforms used by TransformedDistribution).
+
+Each transform exposes forward / inverse / forward_log_det_jacobian over
+Tensors (taped, so reparameterized sampling stays differentiable)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "PowerTransform",
+           "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+           "StackTransform", "StickBreakingTransform", "TanhTransform",
+           "IndependentTransform"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Bijector base. ``_forward``/``_inverse``/``_fldj`` work on raw
+    arrays; the public methods wrap them as taped ops."""
+
+    # event dims consumed by one application (0 = elementwise)
+    _event_dim = 0
+
+    def forward(self, x):
+        return apply_op(self._forward, x if isinstance(x, Tensor)
+                        else Tensor(x))
+
+    def inverse(self, y):
+        return apply_op(self._inverse, y if isinstance(y, Tensor)
+                        else Tensor(y))
+
+    def forward_log_det_jacobian(self, x):
+        return apply_op(self._fldj, x if isinstance(x, Tensor)
+                        else Tensor(x))
+
+    def inverse_log_det_jacobian(self, y):
+        return apply_op(
+            lambda yd: -self._fldj(self._inverse(yd)),
+            y if isinstance(y, Tensor) else Tensor(y))
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective; inverse returns the positive branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _arr(loc)
+        self.scale = _arr(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _arr(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _fldj(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not a bijection on R^n; the
+    reference pairs it with a reference measure on the simplex)."""
+    _event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform has no log-det (dimension-reducing); "
+            "the reference raises here too")
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} -> interior of the n-simplex (n+1 coordinates summing to 1)
+    via iterated sigmoids — the reference's simplex bijector."""
+    _event_dim = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        zpad = jnp.concatenate([z, jnp.ones(x.shape[:-1] + (1,), x.dtype)],
+                               -1)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        cumpad = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), cum], -1)
+        return zpad * cumpad
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1.0 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), cum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _fldj(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        t = x - offset
+        z = jax.nn.sigmoid(t)
+        cum = jnp.cumprod(1 - z, axis=-1)
+        cumpad = jnp.concatenate(
+            [jnp.ones(x.shape[:-1] + (1,), x.dtype), cum[..., :-1]], -1)
+        # d y_i / d x_i = sigmoid'(t_i) * prod_{j<i}(1-z_j)
+        return jnp.sum(jnp.log(z) + jnp.log1p(-z) + jnp.log(cumpad), -1)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        self._event_dim = len(self.in_event_shape)
+
+    def _forward(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(lead + self.out_event_shape)
+
+    def _inverse(self, y):
+        lead = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(lead + self.in_event_shape)
+
+    def _fldj(self, x):
+        lead = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(lead, x.dtype)
+
+
+class StackTransform(Transform):
+    """Apply the i-th transform to the i-th slice along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, method, x):
+        parts = []
+        n = x.shape[self.axis]
+        for i in range(n):
+            sl = jnp.take(x, i, axis=self.axis)
+            parts.append(getattr(self.transforms[i], method)(sl))
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _fldj(self, x):
+        return self._map("_fldj", x)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_dim = max(
+            (t._event_dim for t in self.transforms), default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = None
+        for t in self.transforms:
+            term = t._fldj(x)
+            # reduce elementwise terms over event dims the chain treats as
+            # a single event
+            while term.ndim > 0 and self._event_dim > t._event_dim and (
+                    term.ndim >= self._event_dim - t._event_dim):
+                term = jnp.sum(
+                    term, axis=tuple(range(
+                        term.ndim - (self._event_dim - t._event_dim),
+                        term.ndim)))
+                break
+            total = term if total is None else total + term
+            x = t._forward(x)
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterpret ``n`` batch dims of ``base`` as event dims (the log-det
+    sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.n = int(reinterpreted_batch_ndims)
+        self._event_dim = base._event_dim + self.n
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        term = self.base._fldj(x)
+        return jnp.sum(term, axis=tuple(range(term.ndim - self.n,
+                                              term.ndim)))

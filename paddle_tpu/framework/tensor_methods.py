@@ -69,7 +69,7 @@ def register_tensor_methods():
 
     added = []
     for mod in (ops.math, ops.manipulation, ops.creation, ops.linalg,
-                ops.longtail, ops.longtail2):
+                ops.longtail, ops.longtail2, ops.longtail3):
         for name in mod.__all__:
             if name in _EXCLUDE or hasattr(Tensor, name):
                 continue

@@ -4,9 +4,10 @@ jax.numpy/lax replaces the reference's ~600 hand-written per-backend kernels
 (paddle/phi/kernels/{cpu,gpu}); the `pallas/` subpackage holds the hand-fused
 kernels that replace paddle/phi/kernels/fusion/gpu (SURVEY.md A3.x).
 """
-from . import creation, linalg, longtail, longtail2, manipulation, math
+from . import creation, linalg, longtail, longtail2, longtail3, manipulation, math
 from .creation import *  # noqa: F401,F403
 from .longtail import *  # noqa: F401,F403
 from .longtail2 import *  # noqa: F401,F403
+from .longtail3 import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .math import *  # noqa: F401,F403

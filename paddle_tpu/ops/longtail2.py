@@ -536,15 +536,9 @@ def zero_(x):
 
 def fill_diagonal_(x, value, offset=0, wrap=False):
     _inplace_guard(x, "fill_diagonal_")
+    from .longtail3 import fill_diagonal  # shared impl incl. wrap
 
-    def fn(a):
-        n1, n2 = a.shape[-2], a.shape[-1]
-        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
-        i = jnp.arange(k) + (-offset if offset < 0 else 0)
-        j = jnp.arange(k) + (offset if offset >= 0 else 0)
-        return a.at[..., i, j].set(value)
-
-    x.set_value(apply_op(fn, _t(x)))
+    x.set_value(fill_diagonal(_t(x), value, offset=offset, wrap=wrap))
     return x
 
 

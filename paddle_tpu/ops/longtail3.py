@@ -1,0 +1,480 @@
+"""Tensor-API long tail, tranche 3 (VERDICT r4 #6 — demand-driven sweep;
+reference: python/paddle/tensor/{math,manipulation,linalg,random}.py).
+
+Selection criterion: ops that upstream-typical model/example code actually
+calls and that earlier tranches missed — torch-compat aliases paddle
+carries (permute/ravel/vdot/mT), window functions for signal work,
+special-function stragglers, the view_as_complex/real pair, and the last
+~2 dozen in-place variants. Same contract as longtail.py: Tensors or
+array-likes in, ``apply_op`` so the tape records VJPs, jit-clean."""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy import special as jsp
+
+from ..framework import random as _random
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    # manipulation / aliases
+    "permute", "ravel", "fliplr", "flipud", "matrix_transpose",
+    "take_along_dim", "negative", "fill_diagonal",
+    "fill_diagonal_tensor", "nonzero_static", "reduce_as", "select",
+    # complex views
+    "view_as_complex", "view_as_real",
+    # linalg tail
+    "vdot", "vecdot", "chain_matmul", "pinverse", "svdvals",
+    "svd_lowrank", "lu_solve", "householder_product", "norm_except_dim",
+    # special / math tail
+    "exp2", "erfcx", "logaddexp2", "igamma", "igammac",
+    "bitwise_invert", "sinc_pi",
+    # windows
+    "hamming_window", "hann_window", "kaiser_window",
+    "blackman_window", "bartlett_window",
+    # in-place tail (generated at the bottom)
+    "cumprod_", "cumsum_", "digamma_", "erf_", "gammainc_", "gammaln_",
+    "i0_", "ldexp_", "lgamma_", "logical_and_", "logical_not_",
+    "logical_or_", "logical_xor_", "logit_", "multigammaln_",
+    "not_equal_", "sigmoid_", "stanh_", "where_", "normal_", "gamma_",
+    "cauchy_", "geometric_", "log_normal_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# ------------------------------------------------------------ manipulation
+
+
+def permute(x, *perm):
+    """torch-compat alias paddle ships: ``x.permute(2, 0, 1)`` ==
+    transpose with that axis order (reference: paddle.permute)."""
+    if len(perm) == 1 and isinstance(perm[0], (list, tuple)):
+        perm = tuple(perm[0])
+    return apply_op(lambda a: jnp.transpose(a, perm), _t(x))
+
+
+def ravel(x):
+    """Flatten to 1-D (reference: paddle.ravel)."""
+    return apply_op(lambda a: a.reshape(-1), _t(x))
+
+
+def fliplr(x):
+    return apply_op(lambda a: a[:, ::-1], _t(x))
+
+
+def flipud(x):
+    return apply_op(lambda a: a[::-1], _t(x))
+
+
+def matrix_transpose(x):
+    """Swap the last two dims (reference: paddle.linalg.matrix_transpose /
+    Tensor.mT)."""
+    return apply_op(lambda a: jnp.swapaxes(a, -2, -1), _t(x))
+
+
+def take_along_dim(x, indices, dim):
+    from .manipulation import take_along_axis
+
+    return take_along_axis(x, indices, dim)
+
+
+def negative(x):
+    return apply_op(jnp.negative, _t(x))
+
+
+def fill_diagonal(x, value, offset=0, wrap=False):
+    """Pure form of fill_diagonal_ (returns a new tensor). ``wrap``
+    continues the diagonal past the bottom of a tall 2-D matrix (numpy's
+    wrap semantics, which the reference follows)."""
+
+    def fn(a):
+        n1, n2 = a.shape[-2], a.shape[-1]
+        if wrap and a.ndim == 2 and n1 > n2 and offset == 0:
+            flat_idx = jnp.arange(0, n1 * n2, n2 + 1)
+            return a.reshape(-1).at[flat_idx].set(value).reshape(a.shape)
+        if wrap and (a.ndim != 2 or offset != 0):
+            raise NotImplementedError(
+                "fill_diagonal: wrap=True is only defined for unbatched "
+                "2-D matrices with offset 0 (numpy semantics)")
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset >= 0 else 0)
+        return a.at[..., i, j].set(value)
+
+    return apply_op(fn, _t(x))
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    """Write tensor ``y`` along the (dim1, dim2) diagonal of ``x``
+    (reference: paddle.fill_diagonal_tensor)."""
+
+    def fn(a, b):
+        a2 = jnp.moveaxis(a, (dim1, dim2), (-2, -1))
+        n1, n2 = a2.shape[-2], a2.shape[-1]
+        k = min(n1, n2 - offset) if offset >= 0 else min(n1 + offset, n2)
+        i = jnp.arange(k) + (-offset if offset < 0 else 0)
+        j = jnp.arange(k) + (offset if offset >= 0 else 0)
+        a2 = a2.at[..., i, j].set(b)
+        return jnp.moveaxis(a2, (-2, -1), (dim1, dim2))
+
+    return apply_op(fn, _t(x), _t(y))
+
+
+def nonzero_static(x, size, fill_value=-1):
+    """Static-shape nonzero: exactly ``size`` rows, padded with
+    ``fill_value`` (reference: paddle.nonzero_static — the jit-safe
+    variant; this is the shape-static nonzero XLA wants anyway)."""
+
+    def fn(a):
+        idx = jnp.stack(jnp.nonzero(
+            a, size=size, fill_value=fill_value), -1)
+        return idx
+
+    return apply_op(fn, _t(x))
+
+
+def reduce_as(x, target):
+    """Sum-reduce ``x`` to ``target``'s shape (reference:
+    paddle.reduce_as — broadcasting's adjoint)."""
+    tgt = _arr(target).shape
+
+    def fn(a):
+        extra = a.ndim - len(tgt)
+        if extra:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        keep = tuple(i for i, (s, t) in enumerate(zip(a.shape, tgt))
+                     if s != t)
+        if keep:
+            a = jnp.sum(a, axis=keep, keepdims=True)
+        return a
+
+    return apply_op(fn, _t(x))
+
+
+def select(x, dim, index):
+    """torch-compat: slice index ``index`` out of axis ``dim``."""
+    return apply_op(lambda a: jnp.take(a, index, axis=dim), _t(x))
+
+
+# ----------------------------------------------------------- complex views
+
+
+def view_as_complex(x):
+    """[..., 2] real -> complex (reference: paddle.as_complex alias with
+    torch's name)."""
+    return apply_op(
+        lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def view_as_real(x):
+    return apply_op(
+        lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), _t(x))
+
+
+# ------------------------------------------------------------- linalg tail
+
+
+def vdot(x, y):
+    """Flattened conjugate dot (reference: paddle.vdot)."""
+    return apply_op(
+        lambda a, b: jnp.vdot(a, b), _t(x), _t(y))
+
+
+def vecdot(x, y, axis=-1):
+    return apply_op(
+        lambda a, b: jnp.sum(jnp.conj(a) * b, axis=axis), _t(x), _t(y))
+
+
+def chain_matmul(*mats):
+    from .longtail2 import multi_dot
+
+    if len(mats) == 1 and isinstance(mats[0], (list, tuple)):
+        mats = tuple(mats[0])
+    return multi_dot(list(mats))
+
+
+def pinverse(x, rcond=1e-15, hermitian=False):
+    return apply_op(
+        lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+        _t(x))
+
+
+def svdvals(x):
+    return apply_op(
+        lambda a: jnp.linalg.svd(a, compute_uv=False), _t(x))
+
+
+def svd_lowrank(x, q=6, niter=2):
+    """Randomized low-rank SVD by subspace iteration (reference:
+    paddle.linalg.svd_lowrank). Deterministic under the framework seed."""
+    key = _random.op_key()
+
+    def fn(a):
+        m, n = a.shape[-2], a.shape[-1]
+        k = min(q, m, n)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, k), a.dtype)
+        y = a @ omega
+        # re-orthonormalize every half-step: bare power iteration washes
+        # out the sub-dominant singular directions in f32
+        qmat, _ = jnp.linalg.qr(y)
+        for _ in range(niter):
+            z, _ = jnp.linalg.qr(jnp.swapaxes(a, -2, -1) @ qmat)
+            qmat, _ = jnp.linalg.qr(a @ z)
+        b = jnp.swapaxes(qmat, -2, -1) @ a
+        u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+        return qmat @ u, s, jnp.swapaxes(vh, -2, -1)
+
+    qkv = apply_op(fn, _t(x))
+    return qkv
+
+
+def lu_solve(b, lu_data, pivots, trans="N"):
+    """Solve A x = b (``trans="N"``) or A^T x = b (``trans="T"``) with a
+    factored LU (reference: paddle.linalg.lu_solve; pivots are 1-based
+    like the reference's LAPACK convention)."""
+    if trans not in ("N", "T", 0, 1):
+        raise ValueError(f"lu_solve: trans must be 'N' or 'T', got "
+                         f"{trans!r}")
+    transpose = trans in ("T", 1)
+
+    def fn(bb, lud, piv):
+        l = jnp.tril(lud, -1) + jnp.eye(lud.shape[-1], dtype=lud.dtype)
+        u = jnp.triu(lud)
+        perm = _pivots_to_perm(piv, lud.shape[-1])
+        if transpose:
+            # A = P^T L U  =>  A^T = U^T L^T P; solve then un-permute
+            y = jax.scipy.linalg.solve_triangular(
+                u.T, bb, lower=True)
+            z = jax.scipy.linalg.solve_triangular(
+                l.T, y, lower=False)
+            inv = jnp.zeros_like(perm).at[perm].set(
+                jnp.arange(perm.shape[0]))
+            return z[..., inv, :]
+        pb = bb[..., perm, :]
+        y = jax.scipy.linalg.solve_triangular(l, pb, lower=True)
+        return jax.scipy.linalg.solve_triangular(u, y, lower=False)
+
+    return apply_op(fn, _t(b), _t(lu_data), _t(pivots))
+
+
+def _pivots_to_perm(piv, n):
+    perm = jnp.arange(n)
+
+    def body(i, p):
+        j = piv[i] - 1
+        pi, pj = p[i], p[j]
+        return p.at[i].set(pj).at[j].set(pi)
+
+    return jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+
+
+def householder_product(x, tau):
+    """Q from Householder reflectors (reference:
+    paddle.linalg.householder_product / LAPACK orgqr)."""
+
+    def fn(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = a[..., :, i]
+            v = jnp.where(jnp.arange(m) < i, 0.0, v)
+            v = v.at[i].set(1.0)
+            h = jnp.eye(m, dtype=a.dtype) - t[..., i] * jnp.outer(v, v)
+            q = q @ h
+        return q[..., :, :n]
+
+    return apply_op(fn, _t(x), _t(tau))
+
+
+def norm_except_dim(v, pow=2, dim=0):
+    """L-``pow`` norm over all dims except ``dim`` (weight-norm helper;
+    reference: paddle.norm_except_dim)."""
+
+    def fn(a):
+        axes = tuple(i for i in range(a.ndim) if i != dim)
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a), pow), axis=axes, keepdims=True),
+            1.0 / pow)
+
+    return apply_op(fn, _t(v))
+
+
+# ------------------------------------------------------------ special tail
+
+
+def exp2(x):
+    return apply_op(jnp.exp2, _t(x))
+
+
+def erfcx(x):
+    """exp(x^2) * erfc(x), switching to the asymptotic series where the
+    direct form overflows."""
+
+    def fn(a):
+        # double-where: clamp the argument fed to the overflowing branch
+        # so the UNTAKEN branch can't poison the VJP with inf * 0 = nan
+        a_small = jnp.where(a > 5.0, 0.0, a)
+        direct = jnp.exp(a_small * a_small) * jsp.erfc(a_small)
+        a_big = jnp.where(a > 5.0, a, 10.0)
+        # for large positive a: erfcx(a) ~ 1/(a sqrt(pi)) * (1 - 1/(2a^2))
+        asym = (1.0 / (a_big * jnp.sqrt(jnp.pi))) * (
+            1 - 0.5 / (a_big * a_big))
+        return jnp.where(a > 5.0, asym, direct)
+
+    return apply_op(fn, _t(x))
+
+
+def logaddexp2(x, y):
+    return apply_op(jnp.logaddexp2, _t(x), _t(y))
+
+
+def igamma(x, a):
+    """Upper? No — paddle.igamma is the LOWER regularized incomplete
+    gamma P(a, x) with (x, a) argument order."""
+    return apply_op(lambda xx, aa: jsp.gammainc(aa, xx), _t(x), _t(a))
+
+
+def igammac(x, a):
+    return apply_op(lambda xx, aa: jsp.gammaincc(aa, xx), _t(x), _t(a))
+
+
+def bitwise_invert(x):
+    from .math import bitwise_not
+
+    return bitwise_not(x)
+
+
+def sinc_pi(x):
+    """Normalized sinc (numpy convention) — helper for the windows."""
+    return apply_op(jnp.sinc, _t(x))
+
+
+# ----------------------------------------------------------------- windows
+
+
+def _window(arr, dtype):
+    from ..framework import dtype as dtypes
+
+    return Tensor._wrap(jnp.asarray(
+        arr, dtypes.convert_dtype(dtype) if dtype else jnp.float32))
+
+
+def hamming_window(window_length, periodic=True, dtype=None):
+    n = window_length + 1 if periodic else window_length
+    w = np.hamming(n)[:window_length]
+    return _window(w, dtype)
+
+
+def hann_window(window_length, periodic=True, dtype=None):
+    n = window_length + 1 if periodic else window_length
+    w = np.hanning(n)[:window_length]
+    return _window(w, dtype)
+
+
+def kaiser_window(window_length, periodic=True, beta=12.0, dtype=None):
+    n = window_length + 1 if periodic else window_length
+    w = np.kaiser(n, beta)[:window_length]
+    return _window(w, dtype)
+
+
+def blackman_window(window_length, periodic=True, dtype=None):
+    n = window_length + 1 if periodic else window_length
+    w = np.blackman(n)[:window_length]
+    return _window(w, dtype)
+
+
+def bartlett_window(window_length, periodic=True, dtype=None):
+    n = window_length + 1 if periodic else window_length
+    w = np.bartlett(n)[:window_length]
+    return _window(w, dtype)
+
+
+# ----------------------------------------------------- in-place tail
+
+
+def _random_inplace(name, sampler):
+    from .longtail2 import _inplace_guard
+
+    def fn_(x, *args, **kwargs):
+        _inplace_guard(x, name)
+        arr = _t(x)._data
+        x.set_value(Tensor._wrap(sampler(arr, *args, **kwargs)))
+        return x
+
+    fn_.__name__ = name
+    fn_.__doc__ = f"Fill in place with {name[:-1]} samples."
+    return fn_
+
+
+normal_ = _random_inplace(
+    "normal_",
+    lambda arr, mean=0.0, std=1.0: (
+        mean + std * jax.random.normal(_random.next_key(), arr.shape,
+                                       jnp.float32)).astype(arr.dtype))
+gamma_ = _random_inplace(
+    "gamma_",
+    lambda arr, alpha=1.0: jax.random.gamma(
+        _random.next_key(), alpha, arr.shape, jnp.float32).astype(
+            arr.dtype))
+cauchy_ = _random_inplace(
+    "cauchy_",
+    lambda arr, loc=0.0, scale=1.0: (
+        loc + scale * jax.random.cauchy(_random.next_key(), arr.shape,
+                                        jnp.float32)).astype(arr.dtype))
+geometric_ = _random_inplace(
+    "geometric_",
+    lambda arr, probs=0.5: jnp.floor(
+        jnp.log(jax.random.uniform(
+            _random.next_key(), arr.shape, jnp.float32, minval=1e-12))
+        / _math.log1p(-probs)).astype(arr.dtype))
+log_normal_ = _random_inplace(
+    "log_normal_",
+    lambda arr, mean=1.0, std=2.0: jnp.exp(
+        mean + std * jax.random.normal(_random.next_key(), arr.shape,
+                                       jnp.float32)).astype(arr.dtype))
+
+
+def _register_inplace_tail():
+    """The last ~20 in-place variants, built from the pure ops exactly
+    like longtail2's _register_inplace (shared _make_inplace)."""
+    from . import longtail as _lt
+    from . import longtail2 as _lt2
+    from . import manipulation as _manip
+    from . import math as _math_mod
+    from .longtail2 import _make_inplace
+
+    here = globals()
+
+    def find(name):
+        for mod in (_math_mod, _manip, _lt, _lt2):
+            f = getattr(mod, name, None)
+            if f is not None:
+                return f
+        raise AttributeError(name)
+
+    names = ["cumprod", "cumsum", "digamma", "erf", "gammainc",
+             "gammaln", "i0", "ldexp", "lgamma", "logical_and",
+             "logical_not", "logical_or", "logical_xor", "logit",
+             "multigammaln", "not_equal", "stanh", "where"]
+    for n in names:
+        here[n + "_"] = _make_inplace(find(n))
+    # sigmoid's pure form lives in nn.functional (importing it here would
+    # cycle ops <-> nn), so build its in-place variant directly
+    def _sigmoid(x):
+        return apply_op(jax.nn.sigmoid, _t(x))
+
+    _sigmoid.__name__ = "sigmoid"
+    here["sigmoid_"] = _make_inplace(_sigmoid)
+
+
+_register_inplace_tail()

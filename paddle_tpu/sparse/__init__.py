@@ -1,0 +1,611 @@
+"""paddle.sparse parity — COO/CSR surface with differentiable compute
+(reference: python/paddle/sparse/ — sparse_coo_tensor, sparse_csr_tensor,
+to_dense, values/indices, matmul, masked_matmul, add; VERDICT r3 #6).
+
+TPU note: XLA has no native sparse storage; sparse tensors hold
+coordinate data and their compute lowers to gather/segment-sum — which is
+exactly how one writes performant "sparse" matmul on a dense-matrix
+machine anyway. Values live as a ``Tensor``, so the eager tape records
+VJPs through ``matmul``/``masked_matmul``/``to_dense`` and gradients land
+on ``values()`` like the reference's sparse autograd."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "SparseCsrTensor", "matmul", "masked_matmul", "add",
+           "is_sparse",
+           # manipulation (r5)
+           "transpose", "reshape", "slice", "sum", "coalesce",
+           "is_same_shape", "mask_as",
+           # elementwise-on-values (r5)
+           "abs", "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+           "atanh", "sqrt", "square", "log1p", "expm1", "relu", "relu6",
+           "leaky_relu", "neg", "pow", "cast", "scale", "deg2rad",
+           "rad2deg", "multiply", "divide", "subtract", "softmax", "nn"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _vt(values):
+    """Keep values as a (possibly gradient-tracking) Tensor."""
+    return values if isinstance(values, Tensor) else Tensor(values)
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self._indices = jnp.asarray(_arr(indices), jnp.int32)  # [ndim, nnz]
+        self._values_t = _vt(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def _values(self):
+        return self._values_t._data
+
+    def indices(self):
+        return Tensor._wrap(self._indices)
+
+    def values(self):
+        """The values Tensor ITSELF — gradients from sparse compute
+        accumulate here (reference: sparse tensor .grad)."""
+        return self._values_t
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def nnz(self):
+        return int(self._indices.shape[1])
+
+    def to_dense(self):
+        idx = tuple(self._indices)
+        shape, dtype = self._shape, self._values.dtype
+
+        def fn(vals):
+            return jnp.zeros(shape, dtype).at[idx].add(vals)
+
+        return apply_op(fn, self._values_t)
+
+    def sparse_dim(self):
+        """How many leading dims the indices cover; trailing dims (if any)
+        are dense inside values — the reference's hybrid COO layout used
+        by e.g. the sparse convs ([N, D, H, W] indexed, C dense)."""
+        return int(self._indices.shape[0])
+
+    def dense_dim(self):
+        return len(self._shape) - self.sparse_dim()
+
+    def coalesce(self):
+        """Merge duplicate coordinates. The coordinate bookkeeping runs on
+        host (indices are concrete in eager mode); the VALUE reduction is
+        an apply_op scatter-add, so gradients flow through coalesced
+        results (e.g. sparse+sparse ``add``)."""
+        sshape = self._shape[:self.sparse_dim()]
+        flat = np.ravel_multi_index(
+            tuple(np.asarray(self._indices)), sshape)
+        uniq, inv = np.unique(flat, return_inverse=True)
+        idx = np.stack(np.unravel_index(uniq, sshape))
+        nuniq = uniq.shape[0]
+        inv_j = jnp.asarray(inv, jnp.int32)
+        tail = self._values.shape[1:]
+        dtype = self._values.dtype
+
+        def fn(vals):
+            return jnp.zeros((nuniq,) + tail, dtype).at[inv_j].add(vals)
+
+        return SparseCooTensor(idx, apply_op(fn, self._values_t),
+                               self._shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self._shape}, nnz={self.nnz()}, "
+                f"dtype={self._values.dtype})")
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(_arr(crows), jnp.int32)
+        self._cols = jnp.asarray(_arr(cols), jnp.int32)
+        self._values_t = _vt(values)
+        self._shape = tuple(int(s) for s in shape)
+
+    @property
+    def _values(self):
+        return self._values_t._data
+
+    def crows(self):
+        return Tensor._wrap(self._crows)
+
+    def cols(self):
+        return Tensor._wrap(self._cols)
+
+    def values(self):
+        return self._values_t
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    def nnz(self):
+        return int(self._cols.shape[0])
+
+    def _rows(self):
+        """Expanded per-nnz row ids (host, static)."""
+        return jnp.asarray(np.repeat(
+            np.arange(self._shape[0]),
+            np.diff(np.asarray(self._crows))), jnp.int32)
+
+    def to_dense(self):
+        rows, cols = self._rows(), self._cols
+        shape, dtype = self._shape, self._values.dtype
+
+        def fn(vals):
+            return jnp.zeros(shape, dtype).at[rows, cols].add(vals)
+
+        return apply_op(fn, self._values_t)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    idx = jnp.asarray(_arr(indices), jnp.int32)
+    vals = _arr(values)
+    if dtype is not None:
+        from ..framework import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=1))
+    return SparseCooTensor(idx, vals, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    vals = _arr(values)
+    if dtype is not None:
+        from ..framework import dtype as dtypes
+
+        vals = vals.astype(dtypes.convert_dtype(dtype))
+    return SparseCsrTensor(crows, cols, vals, shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))
+
+
+def _coo_rows_cols(x):
+    if isinstance(x, SparseCooTensor):
+        if len(x._shape) != 2:
+            raise ValueError("sparse.matmul needs a 2-D sparse operand")
+        return x._indices[0], x._indices[1]
+    return x._rows(), x._cols
+
+
+def _check_inner(sp_shape, dense, sp_side, dense_axis, opname):
+    """Shape validation BEFORE the gather: XLA clamps out-of-bounds
+    gather indices, so a mismatched matmul would return plausible garbage
+    instead of raising (code-review r4)."""
+    want = sp_shape[1] if sp_side == "left" else sp_shape[0]
+    got = dense.shape[dense_axis]
+    if got != want:
+        raise ValueError(
+            f"{opname}: dense dim {got} incompatible with sparse shape "
+            f"{tuple(sp_shape)}")
+
+
+def matmul(x, y):
+    """sparse @ dense via gather + segment-sum — NEVER densifies the
+    sparse operand, and gradients flow to both the sparse values and the
+    dense matrix (reference: paddle.sparse.matmul over spmm kernels)."""
+    if is_sparse(x):
+        rows, cols = _coo_rows_cols(x)
+        m = x._shape[0]
+        yt = y if isinstance(y, Tensor) else Tensor(y)
+        _check_inner(x._shape, yt._data, "left", 0, "sparse.matmul")
+
+        def fn(vals, yd):
+            contrib = vals[:, None] * yd[cols]        # [nnz, N]
+            return jax.ops.segment_sum(contrib, rows, num_segments=m)
+
+        return apply_op(fn, x._values_t, yt)
+    if is_sparse(y):
+        rows, cols = _coo_rows_cols(y)
+        n = y._shape[1]
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        _check_inner(y._shape, xt._data, "right", -1, "sparse.matmul")
+
+        def fn(vals, xd):
+            contrib = vals[:, None] * xd.T[rows]      # [nnz, M]
+            return jax.ops.segment_sum(
+                contrib, cols, num_segments=n).T
+
+        return apply_op(fn, y._values_t, xt)
+    raise TypeError("sparse.matmul needs at least one sparse operand")
+
+
+def masked_matmul(x, y, mask):
+    """(x @ y) evaluated ONLY at ``mask``'s nonzero coordinates, returned
+    sparse with mask's sparsity (reference: paddle.sparse.masked_matmul /
+    SDDMM). Differentiable w.r.t. both dense operands."""
+    if not is_sparse(mask):
+        raise TypeError("mask must be a sparse tensor")
+    rows, cols = _coo_rows_cols(mask)
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    yt = y if isinstance(y, Tensor) else Tensor(y)
+    if (xt._data.shape[0] != mask._shape[0]
+            or yt._data.shape[-1] != mask._shape[1]
+            or xt._data.shape[-1] != yt._data.shape[0]):
+        raise ValueError(
+            f"masked_matmul: shapes {xt._data.shape} @ {yt._data.shape} "
+            f"do not produce mask shape {tuple(mask._shape)}")
+
+    def fn(xd, yd):
+        return jnp.sum(xd[rows] * yd.T[cols], axis=-1)  # [nnz]
+
+    vals = apply_op(fn, xt, yt)
+    if isinstance(mask, SparseCooTensor):
+        return SparseCooTensor(mask._indices, vals, mask._shape)
+    return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+
+
+def _coo_of(sp):
+    """[2, nnz] COO indices for a 2-D sparse tensor (either format)."""
+    if isinstance(sp, SparseCooTensor):
+        return sp._indices
+    return jnp.stack([sp._rows(), sp._cols])
+
+
+def _csr_from_coo(coo: "SparseCooTensor") -> "SparseCsrTensor":
+    """Coalesced 2-D COO → CSR: index bookkeeping on host (static), the
+    values gather traced so gradients survive the conversion."""
+    idx = np.asarray(coo._indices)
+    order = np.lexsort((idx[1], idx[0]))
+    rows, cols = idx[0][order], idx[1][order]
+    crows = np.zeros(coo._shape[0] + 1, np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows)
+    order_j = jnp.asarray(order, jnp.int32)
+    vals = apply_op(lambda v: v[order_j], coo._values_t)
+    return SparseCsrTensor(crows, cols, vals, coo._shape)
+
+
+def add(x, y):
+    """sparse+sparse stays sparse in the LEFT operand's format
+    (concatenated coordinates, coalesced); anything involving a dense
+    operand returns dense. Differentiable."""
+    if is_sparse(x) and is_sparse(y):
+        if tuple(x._shape) != tuple(y._shape):
+            raise ValueError(
+                f"sparse.add: shapes {tuple(x._shape)} and "
+                f"{tuple(y._shape)} must match (no sparse broadcasting)")
+        idx = jnp.concatenate([_coo_of(x), _coo_of(y)], axis=1)
+        vals = apply_op(lambda a, b: jnp.concatenate([a, b]),
+                        x._values_t, y._values_t)
+        out = SparseCooTensor(idx, vals, x._shape).coalesce()
+        if isinstance(x, SparseCsrTensor):
+            return _csr_from_coo(out)
+        return out
+    xd = x.to_dense() if is_sparse(x) else (
+        x if isinstance(x, Tensor) else Tensor(x))
+    yd = y.to_dense() if is_sparse(y) else (
+        y if isinstance(y, Tensor) else Tensor(y))
+    return apply_op(jnp.add, xd, yd)
+
+
+# ----------------------------------------------------------- manipulation --
+# (r5, VERDICT #7: the sparse manipulation tail — transpose/reshape/slice
+# over static coordinates, value compute traced so gradients survive.
+# Reference: python/paddle/sparse/unary.py, binary.py, multiary.py.)
+
+
+def coalesce(x):
+    """Free-function form of SparseCooTensor.coalesce."""
+    if isinstance(x, SparseCsrTensor):
+        return x
+    return x.coalesce()
+
+
+def is_same_shape(x, y) -> bool:
+    xs = x.shape if is_sparse(x) else list(_arr(x).shape)
+    ys = y.shape if is_sparse(y) else list(_arr(y).shape)
+    return list(xs) == list(ys)
+
+
+def transpose(x, perm):
+    """Permute sparse dims by reordering the coordinate rows (COO) —
+    values untouched, so this is free on device. CSR round-trips through
+    COO and re-sorts."""
+    if isinstance(x, SparseCsrTensor):
+        return _csr_from_coo(_coo_transpose(_csr_to_coo(x), perm))
+    return _coo_transpose(x, perm)
+
+
+def _csr_to_coo(x: SparseCsrTensor) -> SparseCooTensor:
+    return SparseCooTensor(jnp.stack([x._rows(), x._cols]), x._values_t,
+                           x._shape)
+
+
+def _coo_transpose(x: SparseCooTensor, perm) -> SparseCooTensor:
+    perm = list(perm)
+    if sorted(perm) != list(range(len(x._shape))):
+        raise ValueError(f"sparse.transpose: bad perm {perm} for "
+                         f"shape {tuple(x._shape)}")
+    ns = x.sparse_dim()
+    if any(perm[i] != i for i in range(ns, len(perm))):
+        raise ValueError(
+            f"sparse.transpose: perm {perm} moves a dense (values) dim of "
+            f"a hybrid tensor with {ns} sparse dims — only the indexed "
+            "dims can be permuted")
+    idx = x._indices[jnp.asarray(perm[:ns], jnp.int32)]
+    shape = tuple(x._shape[p] for p in perm)
+    return SparseCooTensor(idx, x._values_t, shape)
+
+
+def reshape(x, shape):
+    """Reshape by re-linearizing coordinates on host (static); values keep
+    their tape identity."""
+    csr = isinstance(x, SparseCsrTensor)
+    coo = _csr_to_coo(x) if csr else x
+    ns = coo.sparse_dim()
+    old = tuple(coo._shape)
+    tail = old[ns:]
+    shape = list(shape)
+    n = int(np.prod(old[:ns]))
+    if shape.count(-1) > 1:
+        raise ValueError("sparse.reshape: at most one -1 dim")
+    if tail and tuple(shape[-len(tail):]) != tail and -1 not in shape[-len(tail):]:
+        raise ValueError(
+            f"sparse.reshape: the dense (values) tail {tail} of a hybrid "
+            f"tensor must be preserved, got {tuple(shape)}")
+    head = shape[:len(shape) - len(tail)] if tail else shape
+    if -1 in head:
+        rest = int(np.prod([s for s in head if s != -1]))
+        head[head.index(-1)] = n // rest
+    if int(np.prod(head)) != n:
+        raise ValueError(
+            f"sparse.reshape: cannot reshape {old} -> {tuple(shape)}")
+    flat = np.ravel_multi_index(tuple(np.asarray(coo._indices)), old[:ns])
+    idx = np.stack(np.unravel_index(flat, head))
+    out = SparseCooTensor(idx, coo._values_t, tuple(head) + tail)
+    return _csr_from_coo(out) if csr and len(out._shape) == 2 else out
+
+
+def slice(x, axes, starts, ends):
+    """Select the coordinate window [start, end) along each axis (host
+    filter); kept coordinates shift to the new origin. Reference:
+    paddle.sparse.slice."""
+    coo = _csr_to_coo(x) if isinstance(x, SparseCsrTensor) else x
+    idx = np.asarray(coo._indices)
+    ns = coo.sparse_dim()
+    shape = list(coo._shape)
+    keep = np.ones(idx.shape[1], bool)
+    offs = np.zeros(ns, np.int64)
+    for ax, st, en in zip(axes, starts, ends):
+        if ax >= ns:
+            raise ValueError(
+                f"sparse.slice: axis {ax} is a dense (values) dim of a "
+                f"hybrid tensor with {ns} sparse dims")
+        dim = shape[ax]
+        st = st + dim if st < 0 else min(st, dim)
+        en = en + dim if en < 0 else min(en, dim)
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        offs[ax] = st
+        shape[ax] = max(en - st, 0)
+    sel = np.nonzero(keep)[0]
+    sel_j = jnp.asarray(sel, jnp.int32)
+    new_idx = idx[:, sel] - offs[:, None]
+    vals = apply_op(lambda v: v[sel_j], coo._values_t)
+    out = SparseCooTensor(new_idx, vals, tuple(shape))
+    return (_csr_from_coo(out)
+            if isinstance(x, SparseCsrTensor) and len(shape) == 2 else out)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    """Reduce over ``axis`` (sparse result) or everything (dense scalar).
+    Reference: paddle.sparse.sum."""
+    coo = _csr_to_coo(x) if isinstance(x, SparseCsrTensor) else x
+    if axis is None:
+        t = apply_op(lambda v: jnp.sum(v.astype(dtype) if dtype else v),
+                     coo._values_t)
+        return t
+    nd = len(coo._shape)
+    ns = coo.sparse_dim()
+    axis = axis + nd if axis < 0 else axis
+    if axis >= ns:
+        # dense (values) axis of a hybrid tensor: reduce inside values
+        vax = axis - ns + 1
+        vals = apply_op(
+            lambda v: (jnp.sum(v.astype(dtype) if dtype else v, axis=vax,
+                               keepdims=keepdim)), coo._values_t)
+        shape = tuple(s for d, s in enumerate(coo._shape)
+                      if keepdim or d != axis)
+        if keepdim:
+            shape = tuple(1 if d == axis else s
+                          for d, s in enumerate(coo._shape))
+        return SparseCooTensor(coo._indices, vals, shape)
+    rem = [d for d in range(ns) if d != axis]
+    if not rem:
+        # reducing the only sparse axis: the result is dense (shape =
+        # the values tail, or scalar when there is none)
+        dense = apply_op(
+            lambda v: jnp.sum(v.astype(dtype) if dtype else v, axis=0,
+                              keepdims=keepdim), coo._values_t)
+        return dense
+    idx = np.asarray(coo._indices)
+    rem_shape = tuple(coo._shape[d] for d in rem)
+    flat = np.ravel_multi_index(tuple(idx[d] for d in rem), rem_shape)
+    uniq, inv = np.unique(flat, return_inverse=True)
+    inv_j = jnp.asarray(inv, jnp.int32)
+    nuniq = int(uniq.shape[0])
+    vals = apply_op(
+        lambda v: jax.ops.segment_sum(
+            v.astype(dtype) if dtype else v, inv_j, num_segments=nuniq),
+        coo._values_t)
+    new_idx = np.stack(np.unravel_index(uniq, rem_shape))
+    tail = tuple(coo._shape[ns:])
+    if keepdim:
+        full = np.insert(new_idx, axis, 0, axis=0)
+        shape = tuple(1 if d == axis else coo._shape[d] for d in range(nd))
+        out = SparseCooTensor(full, vals, shape)
+    else:
+        out = SparseCooTensor(new_idx, vals, rem_shape + tail)
+    return (_csr_from_coo(out) if isinstance(x, SparseCsrTensor)
+            and len(out._shape) == 2 else out)
+
+
+def mask_as(x, mask):
+    """Dense ``x`` sampled at ``mask``'s coordinates, returned in mask's
+    format (reference: paddle.sparse.mask_as)."""
+    if not is_sparse(mask):
+        raise TypeError("mask must be sparse")
+    coo = _csr_to_coo(mask) if isinstance(mask, SparseCsrTensor) else mask
+    idx = tuple(coo._indices)
+    xt = x if isinstance(x, Tensor) else Tensor(x)
+    vals = apply_op(lambda xd: xd[idx], xt)
+    if isinstance(mask, SparseCsrTensor):
+        return SparseCsrTensor(mask._crows, mask._cols, vals, mask._shape)
+    return SparseCooTensor(coo._indices, vals, mask._shape)
+
+
+# --------------------------------------------------- elementwise on values --
+
+
+def _unary(name, fn):
+    def op(x, *args):
+        if not is_sparse(x):
+            raise TypeError(f"sparse.{name} needs a sparse tensor")
+        vals = apply_op(lambda v: fn(v, *args), x._values_t)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return SparseCooTensor(x._indices, vals, x._shape)
+
+    op.__name__ = name
+    op.__doc__ = (f"Zero-preserving elementwise {name} on the stored "
+                  f"values (reference: paddle.sparse.{name}).")
+    return op
+
+
+abs = _unary("abs", jnp.abs)
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+expm1 = _unary("expm1", jnp.expm1)
+relu = _unary("relu", jax.nn.relu)
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0.0, 6.0))
+neg = _unary("neg", jnp.negative)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+pow = _unary("pow", lambda v, p: jnp.power(v, p))
+scale = _unary("scale", lambda v, s: v * s)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary("leaky_relu",
+                  lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..framework import dtype as dtypes
+
+    vals = x._values_t
+    if value_dtype is not None:
+        vals = apply_op(
+            lambda v: v.astype(dtypes.convert_dtype(value_dtype)), vals)
+    if isinstance(x, SparseCsrTensor):
+        crows, cols = x._crows, x._cols
+        if index_dtype is not None:
+            it = dtypes.convert_dtype(index_dtype)
+            crows, cols = crows.astype(it), cols.astype(it)
+        return SparseCsrTensor(crows, cols, vals, x._shape)
+    idx = x._indices
+    if index_dtype is not None:
+        idx = idx.astype(dtypes.convert_dtype(index_dtype))
+    return SparseCooTensor(idx, vals, x._shape)
+
+
+def _aligned_binary(name, fn):
+    """sparse (op) sparse on the UNION pattern: coalesce both, build the
+    union coordinate set on host, scatter each operand's values into it,
+    apply fn. Zero-preserving fns keep the result sparse-correct."""
+
+    def op(x, y):
+        if not (is_sparse(x) and is_sparse(y)):
+            raise TypeError(f"sparse.{name} needs two sparse tensors")
+        if list(x.shape) != list(y.shape):
+            raise ValueError(f"sparse.{name}: shape mismatch "
+                             f"{x.shape} vs {y.shape}")
+        csr = isinstance(x, SparseCsrTensor)
+        xc = (_csr_to_coo(x) if isinstance(x, SparseCsrTensor)
+              else x).coalesce()
+        yc = (_csr_to_coo(y) if isinstance(y, SparseCsrTensor)
+              else y).coalesce()
+        shape = tuple(xc._shape)
+        sshape = shape[:xc.sparse_dim()]
+        fx = np.ravel_multi_index(tuple(np.asarray(xc._indices)), sshape)
+        fy = np.ravel_multi_index(tuple(np.asarray(yc._indices)), sshape)
+        union = np.union1d(fx, fy)
+        px = jnp.asarray(np.searchsorted(union, fx), jnp.int32)
+        py = jnp.asarray(np.searchsorted(union, fy), jnp.int32)
+        nu = int(union.shape[0])
+        idx = np.stack(np.unravel_index(union, sshape))
+        tail = xc._values.shape[1:]
+
+        def combine(xv, yv):
+            dtype = jnp.result_type(xv.dtype, yv.dtype)
+            xs = jnp.zeros((nu,) + tail, dtype).at[px].set(xv)
+            ys = jnp.zeros((nu,) + tail, dtype).at[py].set(yv)
+            return fn(xs, ys)
+
+        vals = apply_op(combine, xc._values_t, yc._values_t)
+        out = SparseCooTensor(idx, vals, shape)
+        return _csr_from_coo(out) if csr and len(shape) == 2 else out
+
+    op.__name__ = name
+    return op
+
+
+multiply = _aligned_binary("multiply", jnp.multiply)
+subtract = _aligned_binary("subtract", jnp.subtract)
+divide = _aligned_binary("divide", jnp.divide)
+
+
+def softmax(x, axis=-1):
+    """Row-wise softmax over the STORED values (zeros stay zero — the
+    reference's sparse softmax semantics, which normalizes over the
+    nonzeros of each row). 2-D COO/CSR, last axis."""
+    if axis not in (-1, 1):
+        raise ValueError("sparse.softmax: only the last axis of a 2-D "
+                         "sparse matrix is supported")
+    coo2 = _csr_to_coo(x) if isinstance(x, SparseCsrTensor) else x
+    if len(coo2._shape) != 2:
+        raise ValueError("sparse.softmax needs a 2-D sparse tensor")
+    rows = coo2._indices[0]
+    m = coo2._shape[0]
+
+    def fn(v):
+        rmax = jax.ops.segment_max(v, rows, num_segments=m)
+        e = jnp.exp(v - rmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=m)
+        return e / denom[rows]
+
+    vals = apply_op(fn, coo2._values_t)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+    return SparseCooTensor(x._indices, vals, x._shape)
+
+
+from . import nn  # noqa: E402  (layer surface over the ops above)

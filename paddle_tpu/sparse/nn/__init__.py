@@ -1,0 +1,129 @@
+"""paddle.sparse.nn parity — layer wrappers over the sparse functional
+core (reference: python/paddle/sparse/nn/ — ReLU, ReLU6, LeakyReLU,
+Softmax, BatchNorm, SyncBatchNorm, SubmConv3D, Conv3D, MaxPool3D).
+Values stay taped Tensors, so these train like their dense cousins."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.tensor import Tensor, apply_op
+from ...nn import functional as dense_F
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from .. import SparseCooTensor, SparseCsrTensor
+from . import functional as F
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "SubmConv3D", "Conv3D", "MaxPool3D"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return F.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return F.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        return F.leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return F.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the stored values' channel dim: the nnz axis plays
+    the batch role, exactly the reference's sparse BatchNorm semantics
+    (normalize the active sites, leave zeros zero)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum, self.epsilon = momentum, epsilon
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self.register_buffer(
+            "_mean", Tensor(np.zeros(num_features, np.float32)))
+        self.register_buffer(
+            "_variance", Tensor(np.ones(num_features, np.float32)))
+
+    def forward(self, x):
+        vals = dense_F.batch_norm(
+            x.values(), self._mean, self._variance, self.weight, self.bias,
+            training=self.training, momentum=self.momentum,
+            epsilon=self.epsilon, data_format="NCHW",
+            use_global_stats=self.use_global_stats)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+        return SparseCooTensor(x._indices, vals, x._shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Single-process twin of the reference's SyncBatchNorm: under pjit
+    the values batch is already global, so the stats ARE synchronized."""
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        ks = F._as_tuple3(kernel_size)
+        self.kernel_size = ks
+        self.stride = F._as_tuple3(stride)
+        self.padding = F._as_tuple3(padding)
+        self.in_channels, self.out_channels = in_channels, out_channels
+        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        bound = 1.0 / float(np.sqrt(fan_in))
+        self.weight = self.create_parameter(
+            ks + (in_channels, out_channels), attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True))
+
+
+class SubmConv3D(_SparseConvBase):
+    """Reference: paddle.sparse.nn.SubmConv3D (submanifold conv for point
+    clouds; sparse_conv3d kernel, subm=True)."""
+
+    def forward(self, x):
+        return F.subm_conv3d(x, self.weight, self.bias)
+
+
+class Conv3D(_SparseConvBase):
+    """Reference: paddle.sparse.nn.Conv3D."""
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, stride=self.stride,
+                        padding=self.padding)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NDHWC"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (
+            kernel_size, stride, padding)
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding)

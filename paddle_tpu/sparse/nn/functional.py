@@ -1,0 +1,186 @@
+"""paddle.sparse.nn.functional parity — activations over stored values and
+the submanifold/full sparse 3-D convolutions (reference:
+python/paddle/sparse/nn/functional/ — relu, softmax, conv3d, subm_conv3d).
+
+TPU design: coordinates are static host data in eager mode, so the conv
+"rulebook" (which input point feeds which output point through which
+kernel offset) is built once with numpy dicts; the device side is pure
+gather → [nnz_k, Cin] @ [Cin, Cout] → segment-sum, which XLA maps onto
+the MXU, and gradients flow to values and weights through the tape."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+from .. import (SparseCooTensor, relu, relu6, leaky_relu, softmax,
+                is_sparse)
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax", "conv3d",
+           "subm_conv3d", "max_pool3d"]
+
+
+def _as_tuple3(v):
+    if isinstance(v, (list, tuple)):
+        if len(v) != 3:
+            raise ValueError(f"expected 3 ints, got {v}")
+        return tuple(int(i) for i in v)
+    return (int(v),) * 3
+
+
+def _out_spatial(in_spatial, kernel_size, stride, padding):
+    return tuple(
+        (in_spatial[i] + 2 * padding[i] - kernel_size[i]) // stride[i] + 1
+        for i in range(3))
+
+
+def _rulebook(coords, in_spatial, kernel_size, stride, padding, subm):
+    """Host-side gather/scatter plan. ``coords``: [nnz, 4] (b, z, y, x).
+    Returns (out_coords [m, 4], list of (offset_id, in_idx, out_idx)).
+    Output sites are bounds-checked against the conv's output spatial
+    shape on BOTH ends (code-review r5: padding > 0 used to emit
+    coordinates past the upper edge)."""
+    ks = kernel_size
+    st = _as_tuple3(stride)
+    pad = _as_tuple3(padding)
+    lim = _out_spatial(in_spatial, ks, st, pad)
+    key = {}
+    if subm:
+        # output sites = input sites (submanifold: no dilation of the
+        # active set — the property that makes point-cloud nets deep)
+        out_coords = coords
+        for i, c in enumerate(map(tuple, coords)):
+            key[c] = i
+    else:
+        gen = {}
+        for c in coords:
+            b, z, y, x = (int(v) for v in c)
+            for dz in range(ks[0]):
+                for dy in range(ks[1]):
+                    for dx in range(ks[2]):
+                        oz, rz = divmod(z + pad[0] - dz, st[0])
+                        oy, ry = divmod(y + pad[1] - dy, st[1])
+                        ox, rx = divmod(x + pad[2] - dx, st[2])
+                        if (rz or ry or rx or oz < 0 or oy < 0 or ox < 0
+                                or oz >= lim[0] or oy >= lim[1]
+                                or ox >= lim[2]):
+                            continue
+                        gen[(b, oz, oy, ox)] = True
+        out_coords = np.array(sorted(gen), np.int32).reshape(-1, 4)
+        for i, c in enumerate(map(tuple, out_coords)):
+            key[c] = i
+    rules = []
+    for kid in range(ks[0] * ks[1] * ks[2]):
+        dz, r = divmod(kid, ks[1] * ks[2])
+        dy, dx = divmod(r, ks[2])
+        in_idx, out_idx = [], []
+        for i, c in enumerate(coords):
+            b, z, y, x = (int(v) for v in c)
+            oz, rz = divmod(z + pad[0] - dz, st[0])
+            oy, ry = divmod(y + pad[1] - dy, st[1])
+            ox, rx = divmod(x + pad[2] - dx, st[2])
+            if rz or ry or rx:
+                continue
+            j = key.get((b, oz, oy, ox))
+            if j is not None:
+                in_idx.append(i)
+                out_idx.append(j)
+        if in_idx:
+            rules.append((kid, np.array(in_idx, np.int32),
+                          np.array(out_idx, np.int32)))
+    return out_coords, rules
+
+
+def _sparse_conv(x, weight, bias, stride, padding, subm, opname):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError(f"{opname} needs a SparseCooTensor input")
+    if len(x._shape) != 5:
+        raise ValueError(f"{opname}: x must be [N, D, H, W, C] sparse, "
+                         f"got shape {x.shape}")
+    w = weight if isinstance(weight, Tensor) else Tensor(weight)
+    kd, kh, kw, cin, cout = w._data.shape
+    if x._shape[-1] != cin:
+        raise ValueError(f"{opname}: in_channels {x._shape[-1]} != "
+                         f"weight's {cin}")
+    coords = np.asarray(x._indices).T  # [nnz, 4] (b, z, y, x)
+    out_coords, rules = _rulebook(coords, x._shape[1:4], (kd, kh, kw),
+                                  stride, padding, subm)
+    m = out_coords.shape[0]
+    w2 = apply_op(lambda wd: wd.reshape(kd * kh * kw, cin, cout), w)
+    gather = [(jnp.asarray(i, jnp.int32), jnp.asarray(o, jnp.int32), kid)
+              for kid, i, o in rules]
+
+    def fn(vals, wk):
+        out = jnp.zeros((m, cout), jnp.result_type(vals.dtype, wk.dtype))
+        for in_j, out_j, kid in gather:
+            contrib = vals[in_j] @ wk[kid]
+            out = out.at[out_j].add(contrib)
+        return out
+
+    out_vals = apply_op(fn, x._values_t, w2)
+    if bias is not None:
+        b = bias if isinstance(bias, Tensor) else Tensor(bias)
+        out_vals = apply_op(lambda v, bb: v + bb, out_vals, b)
+    if subm:
+        shape = tuple(x._shape[:-1]) + (cout,)
+    else:
+        st, pad = _as_tuple3(stride), _as_tuple3(padding)
+        sp = tuple(
+            (x._shape[1 + i] + 2 * pad[i] - (kd, kh, kw)[i]) // st[i] + 1
+            for i in range(3))
+        shape = (x._shape[0],) + sp + (cout,)
+    return SparseCooTensor(out_coords.T, out_vals, shape)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None):
+    """Submanifold sparse conv: output active set == input active set
+    (reference: paddle.sparse.nn.functional.subm_conv3d over the
+    sparse_conv3d kernel with subm=True). stride must be 1."""
+    if _as_tuple3(stride) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 (the submanifold "
+                         "property needs aligned in/out lattices)")
+    if _as_tuple3(dilation) != (1, 1, 1) or groups != 1:
+        raise NotImplementedError("dilation/groups not supported")
+    ks = tuple(int(s) for s in weight.shape[:3])  # per-dim, non-cubic ok
+    pad = tuple(k // 2 for k in ks)  # centered window
+    return _sparse_conv(x, weight, bias, 1, pad, True, "subm_conv3d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups=1, data_format="NDHWC"):
+    """Full sparse conv (the active set dilates by the kernel support).
+    Reference: paddle.sparse.nn.functional.conv3d."""
+    if _as_tuple3(dilation) != (1, 1, 1) or groups != 1:
+        raise NotImplementedError("dilation/groups not supported")
+    return _sparse_conv(x, weight, bias, stride, padding, False, "conv3d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format="NDHWC"):
+    """Sparse max-pool over the active sites in each output window
+    (reference: paddle.sparse.nn.functional.max_pool3d)."""
+    if not isinstance(x, SparseCooTensor) or len(x._shape) != 5:
+        raise TypeError("max_pool3d needs a [N, D, H, W, C] SparseCooTensor")
+    ks = _as_tuple3(kernel_size)
+    st = _as_tuple3(stride if stride is not None else kernel_size)
+    pad = _as_tuple3(padding)
+    coords = np.asarray(x._indices).T
+    out_coords, rules = _rulebook(coords, x._shape[1:4], ks, st, pad,
+                                  False)
+    m = out_coords.shape[0]
+    cin = x._shape[-1]
+    pairs_in = np.concatenate([i for _, i, _ in rules])
+    pairs_out = np.concatenate([o for _, o, _ in rules])
+    in_j = jnp.asarray(pairs_in, jnp.int32)
+    out_j = jnp.asarray(pairs_out, jnp.int32)
+
+    def fn(vals):
+        return jax.ops.segment_max(vals[in_j], out_j, num_segments=m)
+
+    out_vals = apply_op(fn, x._values_t)
+    sp = tuple((x._shape[1 + i] + 2 * pad[i] - ks[i]) // st[i] + 1
+               for i in range(3))
+    return SparseCooTensor(out_coords.T, out_vals,
+                           (x._shape[0],) + sp + (cin,))

@@ -1,0 +1,65 @@
+"""Microbench the packed causal flash kernel fwd/bwd at train shapes.
+
+Usage: python tools/mb_flash.py [S ...]  (default 1024 2048 4096)
+Prints per-S: fwd ms, bwd ms, achieved causal-attention TFLOP/s for each,
+so kernel variants can be compared directly. Timing follows the tunnel
+discipline (chain + scalar fetch; median of reps).
+"""
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from paddle_tpu.ops.pallas import causal_flash as cf
+
+B, H, D = 8, 16, 64
+HPB = cf.heads_per_block(H, D)
+LANES = HPB * D
+GH3 = 3 * H // HPB
+
+PEAK = 394e12  # v5e bf16 peak
+
+
+def timeit(fn, *args, reps=5, inner=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            out = fn(*args)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) / inner)
+    return float(np.median(ts))
+
+
+def main():
+    seqs = [int(s) for s in sys.argv[1:]] or [1024, 2048, 4096]
+    for S in seqs:
+        key = jax.random.PRNGKey(0)
+        qkv = jax.random.normal(key, (B, GH3, S, LANES), jnp.bfloat16)
+
+        fwd = jax.jit(lambda x: cf.causal_flash_qkv(x, H, D))
+
+        def loss(x):
+            return jnp.sum(cf.causal_flash_qkv(x, H, D).astype(jnp.float32))
+
+        gfn = jax.jit(jax.grad(loss))
+
+        t_f = timeit(fwd, qkv)
+        t_g = timeit(gfn, qkv)
+        # causal attention matmul FLOPs (triangle): fwd = 2 dots, bwd adds 4
+        # more (dp, dq, dk, dv) plus the fwd recompute of s
+        tri = S * S / 2
+        f_fwd = 2 * 2 * tri * D * H * B
+        f_bwd = f_fwd / 2 * 5  # s, dp, dq, dk, dv re-dots over the triangle
+        print(f"S={S}: fwd {t_f*1e3:7.3f} ms ({f_fwd/t_f/1e12:6.2f} TF/s, "
+              f"{f_fwd/t_f/PEAK*100:4.1f}%)  fwd+bwd {t_g*1e3:7.3f} ms "
+              f"({(f_fwd+f_bwd)/t_g/1e12:6.2f} TF/s, "
+              f"{(f_fwd+f_bwd)/t_g/PEAK*100:4.1f}%)")
+
+
+if __name__ == "__main__":
+    main()

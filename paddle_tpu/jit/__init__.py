@@ -105,6 +105,25 @@ import contextlib
 
 
 @contextlib.contextmanager
+def swapped_tensors(tensors, arrays):
+    """Swap raw ``arrays`` into an explicit list of Tensors for the
+    duration of a traced region. The generalization of
+    :func:`swapped_params` used when non-parameter state must travel as
+    jit ARGUMENTS too — e.g. the serving engine's quantized-weight
+    buffers (``WeightOnlyLinear`` registers int8/int4 weights as buffers,
+    and baking 100s of MB of them into the program as constants would
+    bloat every compile)."""
+    saved = [t._data for t in tensors]
+    try:
+        for t, a in zip(tensors, arrays):
+            t._data = a
+        yield
+    finally:
+        for t, d in zip(tensors, saved):
+            t._data = d
+
+
+@contextlib.contextmanager
 def swapped_params(layer, arrays):
     """Swap ``arrays`` (ordered like ``layer.named_parameters()``) into the
     layer's parameter storage for the duration of a traced region — the

@@ -1,0 +1,44 @@
+"""One-config train microbench against the persistent compile cache.
+
+Usage: python tools/mb_train.py SEQ [BATCH] [STEPS] [TAG]
+Appends a JSON line to tools/mb_results.jsonl (never pipe benches
+through tail — results must survive the process)."""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.framework.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+
+import bench  # noqa: E402
+from paddle_tpu.models.gpt import GPTConfig  # noqa: E402
+
+
+def main():
+    seq = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+    batch = int(sys.argv[2]) if len(sys.argv) > 2 else (8 if seq >= 2048
+                                                       else 12)
+    steps = int(sys.argv[3]) if len(sys.argv) > 3 else 10
+    tag = sys.argv[4] if len(sys.argv) > 4 else "baseline"
+    cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                    max_position=seq, vocab_size=50304)
+    t0 = time.perf_counter()
+    r = bench.bench_train(cfg, batch=batch, seq=seq, steps=steps)
+    wall = time.perf_counter() - t0
+    line = {"tag": tag, "seq": seq, "batch": batch,
+            "mfu": round(r["mfu"], 4),
+            "mfu_incl_attn": round(r["mfu_incl_attn"], 4),
+            "tokens_per_sec": round(r["tokens_per_sec"], 1),
+            "loss": round(r["loss"], 4), "wall_s": round(wall, 1)}
+    with open("tools/mb_results.jsonl", "a") as f:
+        f.write(json.dumps(line) + "\n")
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

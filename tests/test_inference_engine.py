@@ -287,3 +287,76 @@ class TestInt4Weights:
         # int4 perturbs logits but not wildly (range-correlated check)
         denom = np.abs(logits_ref).mean()
         assert np.abs(logits_q - logits_ref).mean() / denom < 0.35
+
+
+class TestPreAdmission:
+    def test_turnover_prefills_in_chain_shadow(self, gpt, rng):
+        """With 2x-slots queued greedy requests (no eos), completions are
+        predictable and queue heads pre-admit during the freeing chain —
+        results must still exactly match the contiguous path."""
+        eng = Engine(gpt, max_slots=2, num_pages=96, page_size=8,
+                     chunk_size=4, max_chain=2, dtype=jnp.float32)
+        prompts = [rng.integers(0, 97, (n,)) for n in (5, 9, 7, 11, 6)]
+        reqs = [eng.add_request(p, 12) for p in prompts]
+        steps = 0
+        while eng.step():
+            steps += 1
+        assert all(r.done and len(r.tokens) == 12 for r in reqs)
+        for r, p in zip(reqs, prompts):
+            want = gpt.generate(Tensor._wrap(jnp.asarray(p[None])),
+                                max_new_tokens=12, temperature=0.0)
+            np.testing.assert_array_equal(
+                r.tokens, np.asarray(want)[0, p.size:],
+                err_msg=f"request {r.rid}")
+
+    def test_eos_disables_preadmission(self, gpt, rng):
+        """eos makes completions unpredictable; the engine must not
+        speculate (gate returns empty EVEN with queued requests and
+        predicted-complete actives) and still serve correctly."""
+        eng = Engine(gpt, max_slots=2, num_pages=96, page_size=8,
+                     chunk_size=4, max_chain=2, dtype=jnp.float32,
+                     eos_id=96)
+        eng.add_request(rng.integers(0, 96, (5,)), 4)
+        eng.add_request(rng.integers(0, 96, (5,)), 4)
+        eng.add_request(rng.integers(0, 96, (6,)), 4)  # stays queued
+        eng._admit()
+        assert eng._queue and eng._active  # the gate's real precondition
+        got = eng._preadmit_dispatch(2)
+        assert got == ([], None, None)
+        prompts = [rng.integers(0, 96, (n,)) for n in (5, 9, 7)]
+        reqs = [eng.add_request(p, 10) for p in prompts]
+        eng.run()
+        assert all(r.done for r in reqs)
+
+    def test_pool_pressure_skips_preadmission(self, gpt, rng):
+        """A pool too tight for a standalone prefill row falls back to
+        normal (post-turnover) admission rather than failing."""
+        eng = Engine(gpt, max_slots=2, num_pages=20, page_size=8,
+                     chunk_size=4, max_chain=1, dtype=jnp.float32)
+        prompts = [rng.integers(0, 97, (n,)) for n in (5, 9, 7, 6)]
+        reqs = [eng.add_request(p, 8) for p in prompts]
+        eng.run()
+        assert all(r.done and len(r.tokens) == 8 for r in reqs)
+        for r, p in zip(reqs, prompts):
+            want = gpt.generate(Tensor._wrap(jnp.asarray(p[None])),
+                                max_new_tokens=8, temperature=0.0)
+            np.testing.assert_array_equal(
+                r.tokens, np.asarray(want)[0, p.size:])
+
+    def test_sampled_preadmission_deterministic(self, gpt, rng):
+        """A sampled request pre-admitted mid-serve must produce the same
+        tokens as when served alone with the same seed."""
+        def serve(batchmates):
+            eng = Engine(gpt, max_slots=2, num_pages=96, page_size=8,
+                         chunk_size=4, max_chain=2, dtype=jnp.float32)
+            others = [eng.add_request(rng.integers(0, 97, (6,)), 10)
+                      for _ in range(batchmates)]
+            target = eng.add_request(
+                np.arange(5, dtype=np.int32), 10, temperature=0.8,
+                seed=1234)
+            eng.run()
+            return target.tokens
+
+        alone = serve(0)
+        crowded = serve(4)  # forced through the pre-admission path
+        assert alone == crowded

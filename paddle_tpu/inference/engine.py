@@ -44,7 +44,7 @@ output. TPU-first design instead of a C++ executor loop:
   straggler chain-depth clamp is only needed when an eos makes
   completions unpredictable. Measured: the whole mixed bench workload
   serves in 2 scheduling steps at ~81% of steady-state decode
-  throughput (r4: 29%).
+  throughput (r4: 29%; full-process bench.py run recorded 7.7k steady / 6.0k serve = 78%).
 * **Measured chain-boundary cost (VERDICT r4 #2).** Chain depth
   maximizes useful tokens per unit time against a MEASURED
   dispatch+fetch cost (EMA-fitted from warm pure-decode step timings,

@@ -49,6 +49,23 @@ class TestPackedKernel:
         assert not supported(16384, 64)      # beyond tiled VMEM budget
         assert not supported(256, 96)   # head dim not MXU-native
 
+    def test_row_regime_s1024_matches_reference(self, rng):
+        """S=1024 routes to the whole-ROW forward (r5: it beats the
+        whole-sequence square) paired with the whole-seq backward —
+        the cross-regime (row fwd, whole bwd) composition must match
+        naive attention exactly."""
+        B, H, S, D = 1, 2, 1024, 64
+        qkv = jnp.asarray(rng.standard_normal((B, 3 * H, S, D)) * 0.3,
+                          jnp.float32)
+        out = causal_flash_qkv(qkv, H)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(_ref(qkv, H)), atol=1e-5)
+        ct = jnp.asarray(rng.standard_normal(out.shape) * 0.1, jnp.float32)
+        g1 = jax.grad(lambda x: jnp.sum(causal_flash_qkv(x, H) * ct))(qkv)
+        g2 = jax.grad(lambda x: jnp.sum(_ref(x, H) * ct))(qkv)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   atol=2e-5)
+
     def test_tiled_long_seq_matches_reference(self, rng):
         """S=2048 routes to the tiled causal-block-skip kernels (VERDICT
         r3 #2); fwd and the shared-p triangle backward must match naive

@@ -33,16 +33,21 @@ from . import rpc
 
 __all__ = ["ParameterServer", "init_ps", "pull_dense", "push_dense",
            "pull_sparse", "push_sparse", "register_dense", "barrier",
-           "shutdown", "is_server", "is_worker", "server_name"]
+           "shutdown", "is_server", "is_worker", "server_name",
+           "GeoTrainer"]
 
 
 class ParameterServer:
     """Server-side state: dense + sparse tables and their optimizer."""
 
     def __init__(self, lr: float = 0.01, optimizer: str = "sgd",
-                 sparse_dim: int = 8, initializer=None):
-        if optimizer not in ("sgd", "adagrad"):
-            raise ValueError("ParameterServer optimizer: sgd | adagrad")
+                 sparse_dim: int = 8, initializer=None,
+                 beta1: float = 0.9, beta2: float = 0.999):
+        if optimizer not in ("sgd", "adagrad", "adam", "geo"):
+            raise ValueError(
+                "ParameterServer optimizer: sgd | adagrad | adam | geo")
+        self.beta1, self.beta2 = float(beta1), float(beta2)
+        self._adam_step: Dict[str, int] = {}
         self.lr = float(lr)
         self.optimizer = optimizer
         self.sparse_dim = int(sparse_dim)
@@ -71,14 +76,34 @@ class ParameterServer:
             return self._dense[name].copy()
 
     def push_dense(self, name: str, grad: np.ndarray):
+        """Apply a worker's dense update. ``grad`` is a gradient for
+        sgd/adagrad/adam; for ``geo`` it is a PARAMETER DELTA from local
+        training (reference: GeoOptimizer — workers train locally for
+        k_steps, then ship param diffs the server sums)."""
         g = np.asarray(grad, np.float32)
         with self._mu:
             p = self._dense[name]
-            if self.optimizer == "adagrad":
+            if self.optimizer == "geo":
+                p += g  # delta already carries the worker's local lr
+            elif self.optimizer == "adagrad":
                 acc = self._dense_acc.setdefault(
                     name, np.zeros_like(p))
                 acc += g * g
                 p -= self.lr * g / (np.sqrt(acc) + 1e-8)
+            elif self.optimizer == "adam":
+                m = self._dense_acc.setdefault(
+                    name + "/m", np.zeros_like(p))
+                v = self._dense_acc.setdefault(
+                    name + "/v", np.zeros_like(p))
+                t = self._adam_step.get(name, 0) + 1
+                self._adam_step[name] = t
+                m *= self.beta1
+                m += (1 - self.beta1) * g
+                v *= self.beta2
+                v += (1 - self.beta2) * g * g
+                mh = m / (1 - self.beta1 ** t)
+                vh = v / (1 - self.beta2 ** t)
+                p -= self.lr * mh / (np.sqrt(vh) + 1e-8)
             else:
                 p -= self.lr * g
         return True
@@ -103,11 +128,24 @@ class ParameterServer:
             for i, g in zip(ids, grads):
                 i = int(i)
                 row = self._row(table, i)
-                if self.optimizer == "adagrad":
+                if self.optimizer == "geo":
+                    row += g
+                elif self.optimizer == "adagrad":
                     acc = acc_tab.setdefault(
                         i, np.zeros_like(row))
                     acc += g * g
                     row -= self.lr * g / (np.sqrt(acc) + 1e-8)
+                elif self.optimizer == "adam":
+                    mv = acc_tab.setdefault(
+                        i, np.zeros((2,) + row.shape, np.float32))
+                    key = (table, i)
+                    t = self._adam_step.get(key, 0) + 1
+                    self._adam_step[key] = t
+                    mv[0] = self.beta1 * mv[0] + (1 - self.beta1) * g
+                    mv[1] = self.beta2 * mv[1] + (1 - self.beta2) * g * g
+                    mh = mv[0] / (1 - self.beta1 ** t)
+                    vh = mv[1] / (1 - self.beta2 ** t)
+                    row -= self.lr * mh / (np.sqrt(vh) + 1e-8)
                 else:
                     row -= self.lr * g
         return True
@@ -249,3 +287,49 @@ def shutdown(graceful: bool = True):
     rpc.shutdown(graceful)
     _SERVER = None
     _ROLE["role"] = None
+
+
+class GeoTrainer:
+    """Worker-side geo-SGD driver (reference: the fleet a_sync 'geo' mode
+    with ``k_steps`` — ``GeoOptimizer`` over brpc). Train LOCALLY with any
+    optimizer; every ``k_steps`` calls to :meth:`maybe_sync` the trainer
+    pushes each parameter's DELTA since the last sync (the server, built
+    with ``optimizer="geo"``, sums deltas from all trainers) and pulls the
+    merged value back. Communication drops by k_steps vs per-step push.
+
+    ``push``/``pull``/``register`` default to the module-level RPC-backed
+    functions; injectable for in-process use/testing."""
+
+    def __init__(self, model, k_steps: int = 8, push=None, pull=None,
+                 register=None):
+        self.model = model
+        self.k_steps = int(k_steps)
+        self._push = push if push is not None else push_dense
+        self._pull = pull if pull is not None else pull_dense
+        self._register = (register if register is not None
+                          else register_dense)
+        self._count = 0
+        self._snap = {}
+        for n, p in model.named_parameters():
+            arr = np.asarray(p._data, np.float32)
+            self._register(n, arr)
+            self._snap[n] = arr.copy()
+
+    def maybe_sync(self) -> bool:
+        """Call once per local optimizer step; pushes/pulls every
+        k_steps. Returns True when a sync happened."""
+        self._count += 1
+        if self._count % self.k_steps:
+            return False
+        import jax.numpy as jnp
+
+        from ..framework.tensor import Tensor
+
+        for n, p in self.model.named_parameters():
+            cur = np.asarray(p._data, np.float32)
+            self._push(n, cur - self._snap[n])
+        for n, p in self.model.named_parameters():
+            merged = np.asarray(self._pull(n), np.float32)
+            p._data = jnp.asarray(merged).astype(p._data.dtype)
+            self._snap[n] = merged
+        return True

@@ -8,6 +8,8 @@ from .layer import Layer, LayerList, ParameterList, Sequential
 from .loss import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .pooling import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
+from .rnn import *  # noqa: F401,F403
 from .transformer import (
     MultiHeadAttention,
     Transformer,

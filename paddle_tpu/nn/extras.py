@@ -1,0 +1,426 @@
+"""Layer-surface tail (r5; reference: python/paddle/nn/layer/ — the ~40
+wrappers earlier rounds skipped). Thin Layers over the functional core;
+anything with state (SpectralNorm's power-iteration vector, the conv
+transposes' weights) manages it here."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+
+__all__ = [
+    "MaxPool3D", "AvgPool3D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "MaxUnPool1D", "MaxUnPool2D",
+    "Conv1DTranspose", "Conv3DTranspose",
+    "PixelShuffle", "PixelUnshuffle", "ChannelShuffle",
+    "LogSigmoid", "RReLU", "Maxout", "GumbelSoftmax", "Softmax2D",
+    "PairwiseDistance", "LocalResponseNorm", "InstanceNorm1D",
+    "InstanceNorm3D", "Dropout3D", "AlphaDropout",
+    "Pad1D", "Pad3D", "ZeroPad2D", "Unflatten", "Unfold", "Fold",
+    "Upsample", "UpsamplingNearest2D", "UpsamplingBilinear2D",
+    "HuberLoss", "SoftMarginLoss", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "HingeEmbeddingLoss", "TripletMarginLoss",
+    "TripletMarginWithDistanceLoss", "PoissonNLLLoss", "GaussianNLLLoss",
+    "CTCLoss", "LayerDict", "SpectralNorm",
+]
+
+
+class _Fwd(Layer):
+    """Base for stateless wrappers: subclasses set _fn + captured kwargs."""
+
+    def extra_repr(self):
+        return ", ".join(f"{k}={v}" for k, v in self._kw.items())
+
+
+def _stateless(name, ffn, params):
+    """Build a Layer class whose forward calls ``ffn(x, **captured)``."""
+
+    def __init__(self, *args, **kwargs):
+        Layer.__init__(self)
+        kw = dict(zip(params, args))
+        kw.update(kwargs)
+        kw.pop("name", None)
+        self._kw = kw
+
+    def forward(self, x, *extra):
+        return ffn(x, *extra, **self._kw)
+
+    return type(name, (_Fwd,), {"__init__": __init__, "forward": forward,
+                                "__doc__": f"paddle.nn.{name} (thin "
+                                           f"wrapper over F.{ffn.__name__})"})
+
+
+MaxPool3D = _stateless("MaxPool3D", F.max_pool3d,
+                       ["kernel_size", "stride", "padding"])
+AvgPool3D = _stateless("AvgPool3D", F.avg_pool3d,
+                       ["kernel_size", "stride", "padding"])
+AdaptiveAvgPool3D = _stateless("AdaptiveAvgPool3D", F.adaptive_avg_pool3d,
+                               ["output_size"])
+AdaptiveMaxPool1D = _stateless("AdaptiveMaxPool1D", F.adaptive_max_pool1d,
+                               ["output_size"])
+MaxUnPool1D = _stateless("MaxUnPool1D", F.max_unpool1d, ["kernel_size",
+                                                         "stride"])
+MaxUnPool2D = _stateless("MaxUnPool2D", F.max_unpool2d, ["kernel_size",
+                                                         "stride"])
+PixelShuffle = _stateless("PixelShuffle", F.pixel_shuffle,
+                          ["upscale_factor"])
+PixelUnshuffle = _stateless("PixelUnshuffle", F.pixel_unshuffle,
+                            ["downscale_factor"])
+ChannelShuffle = _stateless("ChannelShuffle", F.channel_shuffle,
+                            ["groups"])
+LogSigmoid = _stateless("LogSigmoid", F.log_sigmoid, [])
+Maxout = _stateless("Maxout", F.maxout, ["groups", "axis"])
+PairwiseDistance = _stateless("PairwiseDistance", F.pairwise_distance,
+                              ["p", "epsilon", "keepdim"])
+LocalResponseNorm = _stateless("LocalResponseNorm", F.local_response_norm,
+                               ["size", "alpha", "beta", "k"])
+Unfold = _stateless("Unfold", F.unfold,
+                    ["kernel_sizes", "strides", "paddings", "dilations"])
+Fold = _stateless("Fold", F.fold,
+                  ["output_sizes", "kernel_sizes", "strides", "paddings",
+                   "dilations"])
+HuberLoss = _stateless("HuberLoss", F.huber_loss, ["delta", "reduction"])
+SoftMarginLoss = _stateless("SoftMarginLoss", F.soft_margin_loss,
+                            ["reduction"])
+MultiLabelSoftMarginLoss = _stateless(
+    "MultiLabelSoftMarginLoss", F.multi_label_soft_margin_loss,
+    ["weight", "reduction"])
+MultiMarginLoss = _stateless("MultiMarginLoss", F.multi_margin_loss,
+                             ["p", "margin", "weight", "reduction"])
+HingeEmbeddingLoss = _stateless("HingeEmbeddingLoss",
+                                F.hinge_embedding_loss,
+                                ["margin", "reduction"])
+PoissonNLLLoss = _stateless("PoissonNLLLoss", F.poisson_nll_loss,
+                            ["log_input", "full", "epsilon", "reduction"])
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._kw = dict(margin=margin, p=p, epsilon=epsilon, swap=swap,
+                        reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative,
+                                     **self._kw)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.distance_function = distance_function
+        self._kw = dict(margin=margin, swap=swap, reduction=reduction)
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative,
+            distance_function=self.distance_function, **self._kw)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._kw = dict(full=full, epsilon=epsilon, reduction=reduction)
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, **self._kw)
+
+
+class CTCLoss(Layer):
+    """Reference: paddle.nn.CTCLoss over warpctc — here the functional
+    log-domain alpha recursion (nn.functional.ctc_loss)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class GumbelSoftmax(Layer):
+    def __init__(self, temperature=1.0, hard=False, axis=-1, name=None):
+        super().__init__()
+        self._kw = dict(temperature=temperature, hard=hard, axis=axis)
+
+    def forward(self, x):
+        return F.gumbel_softmax(x, **self._kw)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, p=self.p, training=self.training)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format=None, name=None):
+        super().__init__()
+        self.padding = (list(padding) if isinstance(padding, (list, tuple))
+                        else [padding] * self._width)
+        self.mode, self.value = mode, value
+        self.data_format = data_format or self._fmt
+
+    def forward(self, x):
+        return F.pad(x, self.padding, mode=self.mode, value=self.value,
+                     data_format=self.data_format)
+
+
+class Pad1D(_PadNd):
+    _width, _fmt = 2, "NCL"
+
+
+class Pad3D(_PadNd):
+    _width, _fmt = 6, "NCDHW"
+
+
+class ZeroPad2D(_PadNd):
+    _width, _fmt = 4, "NCHW"
+
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, mode="constant", value=0.0,
+                         data_format=data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, list(shape)
+
+    def forward(self, x):
+        shp = self.shape
+
+        def fn(a):
+            ax = self.axis % a.ndim  # negative axes wrap (paddle allows)
+            pre = a.shape[:ax]
+            post = a.shape[ax + 1:]
+            return a.reshape(pre + tuple(shp) + post)
+
+        return apply_op(fn, x if isinstance(x, Tensor) else Tensor(x))
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 align_corners=False, align_mode=0, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self._kw = dict(size=size, scale_factor=scale_factor, mode=mode,
+                        align_corners=align_corners,
+                        data_format=data_format)
+
+    def forward(self, x):
+        return F.upsample(x, **self._kw)
+
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="nearest", data_format=data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="bilinear", align_corners=True,
+                         data_format=data_format)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = (None if weight_attr is False else
+                       self.create_parameter(
+                           (num_features,), attr=weight_attr,
+                           default_initializer=I.Constant(1.0)))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_features,), attr=bias_attr, is_bias=True))
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.weight, bias=self.bias,
+                               eps=self.epsilon)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+class _ConvTransposeNd(Layer):
+    _nd = None
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups=1,
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 name=None):
+        super().__init__()
+        nd = self._nd
+        ks = (tuple(kernel_size) if isinstance(kernel_size, (list, tuple))
+              else (kernel_size,) * nd)
+        fan_in = in_channels * int(np.prod(ks))
+        bound = 1.0 / float(np.sqrt(fan_in))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + ks, attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (out_channels,), attr=bias_attr, is_bias=True))
+        self._kw = dict(stride=stride, padding=padding,
+                        output_padding=output_padding, dilation=dilation,
+                        groups=groups)
+
+    def forward(self, x, output_size=None):
+        fn = (F.conv1d_transpose if self._nd == 1 else F.conv3d_transpose)
+        return fn(x, self.weight, self.bias, output_size=output_size,
+                  **self._kw)
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    _nd = 1
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    _nd = 3
+
+
+class LayerDict(Layer):
+    """Dict-style sublayer container (reference: paddle.nn.LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        setattr(self, key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = (sublayers.items() if isinstance(sublayers, dict)
+                 else sublayers)
+        for k, v in items:
+            self[k] = v
+
+    def pop(self, key):
+        layer = self._sub_layers[key]
+        del self._sub_layers[key]
+        return layer
+
+    def clear(self):
+        self._sub_layers.clear()
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight (reference:
+    paddle.nn.SpectralNorm): one power iteration per forward against
+    persistent u/v buffers estimates sigma_max; returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        rng = np.random.default_rng(0)
+
+        def _unit(n):
+            v = rng.standard_normal(n).astype(np.float32)
+            return v / (np.linalg.norm(v) + epsilon)
+
+        self.register_buffer("weight_u", Tensor(_unit(h)))
+        self.register_buffer("weight_v", Tensor(_unit(w)))
+
+    def forward(self, weight):
+        dim, eps, iters = self.dim, self.epsilon, self.power_iters
+        wt = weight if isinstance(weight, Tensor) else Tensor(weight)
+
+        # power iteration ONCE, untaped (u/v are frozen in the standard
+        # SN gradient); the taped part is only the cheap sigma matvec +
+        # division, through which the weight gradient flows
+        mat0 = jnp.moveaxis(wt._data, dim, 0).reshape(
+            wt._data.shape[dim], -1)
+        u, v = self.weight_u._data, self.weight_v._data
+        for _ in range(iters):
+            v = mat0.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat0 @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        self.weight_u._data = u
+        self.weight_v._data = v
+
+        def fn(w):
+            mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            sigma = u @ mat @ v
+            return w / sigma
+
+        return apply_op(fn, wt)
+

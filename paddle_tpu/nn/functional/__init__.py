@@ -72,5 +72,6 @@ from .loss import (
     square_error_cost,
 )
 from .attention import scaled_dot_product_attention, flash_attention
+from .extras import *  # noqa: F401,F403
 
 __all__ = [n for n in dir() if not n.startswith("_")]

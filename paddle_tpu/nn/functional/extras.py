@@ -1,0 +1,619 @@
+"""Functional tail (r5, VERDICT r4 coverage: the ~30 paddle.nn.functional
+ops earlier rounds skipped — 3-D pooling, 1-D/3-D transposed conv, pixel
+ops, the loss tail, instance/local-response norm; reference:
+python/paddle/nn/functional/). Same contract as the rest of the package:
+Tensors or array-likes in, ``apply_op`` so the tape records VJPs."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "max_pool3d", "avg_pool3d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "max_pool2d_with_indices", "max_unpool1d",
+    "max_unpool2d",
+    "conv1d_transpose", "conv3d_transpose",
+    "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
+    "log_sigmoid", "rrelu", "maxout", "gumbel_softmax",
+    "pairwise_distance", "local_response_norm", "instance_norm",
+    "dropout3d", "alpha_dropout", "upsample", "fold",
+    "huber_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
+    "multi_margin_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "triplet_margin_with_distance_loss", "poisson_nll_loss",
+    "gaussian_nll_loss",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor._wrap(jnp.asarray(x))
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * 3
+
+
+def _reduce(val, reduction):
+    if reduction == "none":
+        return val
+    if reduction == "sum":
+        return jnp.sum(val)
+    return jnp.mean(val)
+
+
+# ------------------------------------------------------------- pooling 3d
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCDHW"):
+    k = _triple(kernel_size)
+    s = _triple(stride if stride is not None else kernel_size)
+    p = _triple(padding)
+
+    def fn(a):
+        neg = (-jnp.inf if jnp.issubdtype(a.dtype, jnp.floating)
+               else jnp.iinfo(a.dtype).min)
+        return jax.lax.reduce_window(
+            a, neg, jax.lax.max,
+            window_dimensions=(1, 1) + k,
+            window_strides=(1, 1) + s,
+            padding=((0, 0), (0, 0)) + tuple((pi, pi) for pi in p))
+
+    return apply_op(fn, _t(x))
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None,
+               data_format="NCDHW"):
+    k = _triple(kernel_size)
+    s = _triple(stride if stride is not None else kernel_size)
+    p = _triple(padding)
+
+    def fn(a):
+        summed = jax.lax.reduce_window(
+            a, 0.0, jax.lax.add,
+            window_dimensions=(1, 1) + k,
+            window_strides=(1, 1) + s,
+            padding=((0, 0), (0, 0)) + tuple((pi, pi) for pi in p))
+        if divisor_override:
+            return summed / divisor_override
+        if exclusive and any(p):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(
+                ones, 0.0, jax.lax.add,
+                window_dimensions=(1, 1) + k,
+                window_strides=(1, 1) + s,
+                padding=((0, 0), (0, 0)) + tuple((pi, pi) for pi in p))
+            return summed / counts
+        return summed / float(np.prod(k))
+
+    return apply_op(fn, _t(x))
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    out = _triple(output_size)
+
+    def fn(a):
+        n, c, d, h, w = a.shape
+        if d % out[0] or h % out[1] or w % out[2]:
+            raise ValueError(
+                f"adaptive_avg_pool3d: input {(d, h, w)} not divisible "
+                f"by output {out}")
+        a = a.reshape(n, c, out[0], d // out[0], out[1], h // out[1],
+                      out[2], w // out[2])
+        return a.mean(axis=(3, 5, 7))
+
+    return apply_op(fn, _t(x))
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    out = int(output_size)
+
+    def fn(a):
+        n, c, l = a.shape
+        if l % out:
+            raise ValueError(
+                f"adaptive_max_pool1d: length {l} not divisible by {out}")
+        return a.reshape(n, c, out, l // out).max(axis=-1)
+
+    return apply_op(fn, _t(x))
+
+
+def max_pool2d_with_indices(x, kernel_size, stride=None, padding=0):
+    """Max pool returning (out, flat per-window indices) — the producer
+    side of max_unpool2d. Non-overlapping windows only (stride ==
+    kernel_size, the unpool contract)."""
+    k = kernel_size if isinstance(kernel_size, (tuple, list)) else (
+        kernel_size, kernel_size)
+    s = stride if stride is not None else k
+    s = s if isinstance(s, (tuple, list)) else (s, s)
+    if tuple(k) != tuple(s) or padding:
+        raise NotImplementedError(
+            "max_pool2d_with_indices: non-overlapping windows only "
+            "(stride == kernel_size, padding 0)")
+
+    def indices_of(a):
+        n, c, h, w = a.shape
+        oh, ow = h // k[0], w // k[1]
+        win = a[:, :, :oh * k[0], :ow * k[1]].reshape(
+            n, c, oh, k[0], ow, k[1]).transpose(0, 1, 2, 4, 3, 5).reshape(
+            n, c, oh, ow, k[0] * k[1])
+        idx_in_win = jnp.argmax(win, axis=-1)
+        # flat index into the ORIGINAL [h, w] map (paddle/torch layout)
+        wy = idx_in_win // k[1]
+        wx = idx_in_win % k[1]
+        oy = jnp.arange(oh)[None, None, :, None] * k[0]
+        ox = jnp.arange(ow)[None, None, None, :] * k[1]
+        return ((oy + wy) * w + (ox + wx)).astype(jnp.int32)
+
+    xt = _t(x)
+    # indices are non-differentiable: compute ONCE untaped, then the
+    # taped output is just a gather at those positions (code-review r5:
+    # the old form ran the whole windowing twice)
+    idx_arr = indices_of(xt._data)
+
+    def gather(a):
+        n, c = a.shape[:2]
+        return jnp.take_along_axis(
+            a.reshape(n, c, -1), idx_arr.reshape(n, c, -1),
+            axis=-1).reshape(idx_arr.shape)
+
+    out = apply_op(gather, xt)
+    return out, Tensor._wrap(idx_arr)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    """Scatter pooled values back to their argmax positions (reference:
+    paddle.nn.functional.max_unpool2d)."""
+    if padding:
+        # indices from a padded pool address the padded map; the size
+        # formula and scatter layout below would silently be wrong
+        raise NotImplementedError(
+            "max_unpool2d: padding != 0 not supported (pair with "
+            "max_pool2d_with_indices, which enforces padding 0)")
+    k = kernel_size if isinstance(kernel_size, (tuple, list)) else (
+        kernel_size, kernel_size)
+    s = stride if stride is not None else k
+    s = s if isinstance(s, (tuple, list)) else (s, s)
+    idx = _t(indices)._data.astype(jnp.int32)
+
+    def fn(a):
+        n, c, oh, ow = a.shape
+        if output_size is not None:
+            h, w = output_size[-2], output_size[-1]
+        else:
+            h, w = (oh - 1) * s[0] + k[0], (ow - 1) * s[1] + k[1]
+        flat = jnp.zeros((n, c, h * w), a.dtype)
+        flat = flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None],
+            idx.reshape(n, c, -1)].add(a.reshape(n, c, -1))
+        return flat.reshape(n, c, h, w)
+
+    return apply_op(fn, _t(x))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    if padding:
+        raise NotImplementedError(
+            "max_unpool1d: padding != 0 not supported (see max_unpool2d)")
+    k = kernel_size if not isinstance(kernel_size, (tuple, list)) else (
+        kernel_size[0])
+    s = stride if stride is not None else k
+    s = s[0] if isinstance(s, (tuple, list)) else s
+    idx = _t(indices)._data.astype(jnp.int32)
+
+    def fn(a):
+        n, c, ol = a.shape
+        l = (output_size[-1] if output_size is not None
+             else (ol - 1) * s + k)
+        flat = jnp.zeros((n, c, l), a.dtype)
+        return flat.at[
+            jnp.arange(n)[:, None, None],
+            jnp.arange(c)[None, :, None], idx].add(a)
+
+    return apply_op(fn, _t(x))
+
+
+# -------------------------------------------------------- transposed conv
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, nd, op):
+    st = tuple(stride) if isinstance(stride, (list, tuple)) else (
+        stride,) * nd
+    pd = tuple(padding) if isinstance(padding, (list, tuple)) else (
+        padding,) * nd
+    dl = tuple(dilation) if isinstance(dilation, (list, tuple)) else (
+        dilation,) * nd
+    opad = (tuple(output_padding)
+            if isinstance(output_padding, (list, tuple))
+            else (output_padding,) * nd)
+    if groups != 1:
+        raise NotImplementedError(f"{op}: groups > 1 not supported")
+    dn_str = {1: ("NCH", "IOH", "NCH"), 3: ("NCDHW", "IODHW", "NCDHW")}[nd]
+    args = [_t(x), _t(weight)] + ([_t(bias)] if bias is not None else [])
+
+    def fn(a, w, *b):
+        pads = tuple(
+            (dl[i] * (w.shape[2 + i] - 1) - pd[i],
+             dl[i] * (w.shape[2 + i] - 1) - pd[i] + opad[i])
+            for i in range(nd))
+        out = jax.lax.conv_general_dilated(
+            a, jnp.flip(w, axis=tuple(range(2, 2 + nd))),
+            window_strides=(1,) * nd, padding=pads,
+            lhs_dilation=st, rhs_dilation=dl,
+            dimension_numbers=dn_str)
+        if b:
+            out = out + b[0].reshape((1, -1) + (1,) * nd)
+        return out
+
+    return apply_op(fn, *args)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL"):
+    """Reference: paddle.nn.functional.conv1d_transpose (weight
+    [in, out, k], fractionally-strided conv via lhs_dilation)."""
+    return _conv_transpose(x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, 1,
+                           "conv1d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW"):
+    return _conv_transpose(x, weight, bias, stride, padding,
+                           output_padding, dilation, groups, 3,
+                           "conv3d_transpose")
+
+
+# ------------------------------------------------------------- pixel ops
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    r = int(upscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        return a.transpose(0, 1, 4, 2, 5, 3).reshape(
+            n, c // (r * r), h * r, w * r)
+
+    return apply_op(fn, _t(x))
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    r = int(downscale_factor)
+
+    def fn(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        return a.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, c * r * r, h // r, w // r)
+
+    return apply_op(fn, _t(x))
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    g = int(groups)
+
+    def fn(a):
+        n, c = a.shape[:2]
+        rest = a.shape[2:]
+        return a.reshape((n, g, c // g) + rest).swapaxes(1, 2).reshape(
+            a.shape)
+
+    return apply_op(fn, _t(x))
+
+
+# ----------------------------------------------------------- activations
+
+
+def log_sigmoid(x):
+    return apply_op(jax.nn.log_sigmoid, _t(x))
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    """Randomized leaky ReLU: slope ~ U[lower, upper] per element in
+    training, the mean slope in eval (reference: F.rrelu)."""
+    if training:
+        key = _random.op_key()
+
+        def fn(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32,
+                                       lower, upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, a * slope)
+    else:
+        mid = (lower + upper) / 2.0
+
+        def fn(a):
+            return jnp.where(a >= 0, a, a * mid)
+
+    return apply_op(fn, _t(x))
+
+
+def maxout(x, groups, axis=1):
+    def fn(a):
+        ax = axis % a.ndim  # negative axes wrap (paddle allows)
+        c = a.shape[ax]
+        pre = a.shape[:ax]
+        post = a.shape[ax + 1:]
+        a = a.reshape(pre + (c // groups, groups) + post)
+        return a.max(axis=ax + 1)
+
+    return apply_op(fn, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    key = _random.op_key()
+
+    def fn(a):
+        g = jax.random.gumbel(key, a.shape, jnp.float32).astype(a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            onehot = jax.nn.one_hot(
+                jnp.argmax(y, axis=axis), a.shape[axis], dtype=a.dtype,
+                axis=axis)
+            y = onehot + y - jax.lax.stop_gradient(y)  # straight-through
+        return y
+
+    return apply_op(fn, _t(x))
+
+
+# -------------------------------------------------------- norms / misc
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    return apply_op(
+        lambda a, b: jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b + epsilon), p), axis=-1,
+                    keepdims=keepdim), 1.0 / p), _t(x), _t(y))
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW"):
+    n = int(size)
+
+    def fn(a):
+        sq = jnp.square(a)
+        half = n // 2
+        pad_width = [(0, 0)] * a.ndim
+        pad_width[1] = (half, n - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        acc = sum(padded[:, i:i + a.shape[1]] for i in range(n))
+        return a / jnp.power(k + alpha * acc / n, beta)
+
+    return apply_op(fn, _t(x))
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  eps=1e-5, data_format="NCHW"):
+    args = [_t(x)]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        args.append(_t(weight))
+    if has_b:
+        args.append(_t(bias))
+
+    def fn(a, *wb):
+        axes = tuple(range(2, a.ndim))
+        mean = a.mean(axis=axes, keepdims=True)
+        var = a.var(axis=axes, keepdims=True)
+        out = (a - mean) / jnp.sqrt(var + eps)
+        shape = (1, -1) + (1,) * (a.ndim - 2)
+        i = 0
+        if has_w:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if has_b:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    return apply_op(fn, *args)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    """Drop whole channels of a 5-D input (reference: F.dropout3d)."""
+    if not training or p == 0.0:
+        return _t(x)
+    key = _random.op_key()
+
+    def fn(a):
+        keep = jax.random.bernoulli(
+            key, 1.0 - p, a.shape[:2]).astype(a.dtype)
+        return a * keep[..., None, None, None] / (1.0 - p)
+
+    return apply_op(fn, _t(x))
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    """SELU-preserving dropout (reference: F.alpha_dropout)."""
+    if not training or p == 0.0:
+        return _t(x)
+    key = _random.op_key()
+    alpha_p = -1.7580993408473766  # -scale * alpha of SELU
+    a_coef = (1.0 - p) + p * alpha_p ** 2
+    a_coef = 1.0 / np.sqrt(a_coef)
+    b_coef = -a_coef * p * alpha_p
+
+    def fn(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(
+            a.dtype)
+
+    return apply_op(fn, _t(x))
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW"):
+    from .common import interpolate
+
+    return interpolate(x, size=size, scale_factor=scale_factor,
+                       mode=mode, align_corners=align_corners,
+                       data_format=data_format)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0,
+         dilations=1):
+    """col2im — the inverse of unfold: accumulate [N, C*kh*kw, L] patch
+    columns back into [N, C, H, W] (reference: F.fold)."""
+    oh, ow = (output_sizes if isinstance(output_sizes, (list, tuple))
+              else (output_sizes, output_sizes))
+    kh, kw = (kernel_sizes if isinstance(kernel_sizes, (list, tuple))
+              else (kernel_sizes, kernel_sizes))
+    sh, sw = (strides if isinstance(strides, (list, tuple))
+              else (strides, strides))
+    ph, pw = (paddings if isinstance(paddings, (list, tuple))
+              else (paddings, paddings))
+    dh, dw = (dilations if isinstance(dilations, (list, tuple))
+              else (dilations, dilations))
+
+    def fn(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        cols = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[
+                    :, :,
+                    i * dh:i * dh + nh * sh:sh,
+                    j * dw:j * dw + nw * sw:sw].add(cols[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+
+    return apply_op(fn, _t(x))
+
+
+# ---------------------------------------------------------------- losses
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean"):
+    def fn(a, b):
+        d = a - b
+        absd = jnp.abs(d)
+        val = jnp.where(absd <= delta, 0.5 * d * d,
+                        delta * (absd - 0.5 * delta))
+        return _reduce(val, reduction)
+
+    return apply_op(fn, _t(input), _t(label))
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    def fn(a, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * a)), reduction)
+
+    return apply_op(fn, _t(input), _t(label))
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    args = [_t(input), _t(label)] + ([_t(weight)]
+                                     if weight is not None else [])
+
+    def fn(a, y, *w):
+        per = -(y * jax.nn.log_sigmoid(a)
+                + (1 - y) * jax.nn.log_sigmoid(-a))
+        if w:
+            per = per * w[0]
+        return _reduce(per.mean(axis=-1), reduction)
+
+    return apply_op(fn, *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    args = [_t(input), _t(label)] + ([_t(weight)]
+                                     if weight is not None else [])
+
+    def fn(a, y, *w):
+        gold = jnp.take_along_axis(a, y[:, None].astype(jnp.int32),
+                                   axis=-1)
+        diff = jnp.maximum(margin - gold + a, 0.0) ** p
+        mask = 1.0 - jax.nn.one_hot(y, a.shape[-1], dtype=a.dtype)
+        per = jnp.sum(diff * mask, -1) / a.shape[-1]
+        if w:  # per-class weights indexed by the gold label
+            per = per * w[0][y.astype(jnp.int32)]
+        return _reduce(per, reduction)
+
+    return apply_op(fn, *args)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    def fn(a, y):
+        val = jnp.where(y > 0, a, jnp.maximum(margin - a, 0.0))
+        return _reduce(val, reduction)
+
+    return apply_op(fn, _t(input), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(
+                jnp.sum(jnp.power(jnp.abs(u - v + epsilon), p), -1),
+                1.0 / p)
+
+        dp = dist(a, pos)
+        dn = dist(a, neg)
+        if swap:
+            dn = jnp.minimum(dn, dist(pos, neg))
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(fn, _t(input), _t(positive), _t(negative))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    if distance_function is None:
+        return triplet_margin_loss(input, positive, negative,
+                                   margin=margin, swap=swap,
+                                   reduction=reduction)
+    dp = distance_function(input, positive)
+    dn = distance_function(input, negative)
+    if swap:
+        dpn = distance_function(positive, negative)
+        dn = apply_op(jnp.minimum, dn, dpn)
+    return apply_op(
+        lambda a, b: _reduce(jnp.maximum(a - b + margin, 0.0), reduction),
+        dp, dn)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    def fn(a, y):
+        if log_input:
+            val = jnp.exp(a) - y * a
+        else:
+            val = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = (y * jnp.log(y + epsilon) - y
+                        + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon)))
+            val = val + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(val, reduction)
+
+    return apply_op(fn, _t(input), _t(label))
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    def fn(a, y, var):
+        var = jnp.maximum(var, epsilon)
+        val = 0.5 * (jnp.log(var) + (a - y) ** 2 / var)
+        if full:
+            val = val + 0.5 * jnp.log(2 * jnp.asarray(jnp.pi))
+        return _reduce(val, reduction)
+
+    return apply_op(fn, _t(input), _t(label), _t(variance))

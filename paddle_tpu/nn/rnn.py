@@ -1,0 +1,413 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+RNNCellBase, SimpleRNNCell/LSTMCell/GRUCell, the RNN sequence wrapper and
+the SimpleRNN/LSTM/GRU multi-layer networks).
+
+TPU design: the time loop is a ``lax.scan`` inside one ``apply_op``, so a
+whole sequence (or a whole stacked bidirectional network) traces to a
+single XLA program — per-step Python dispatch would be the exact dygraph
+overhead this framework exists to erase, and scan keeps the compiled
+control flow static for jit. Gate conventions match the reference (which
+match cuDNN/torch): LSTM chunks [i, f, g(c~), o]; GRU chunks [r, z, c~]
+with ``h' = z*h + (1-z)*c~`` and the reset gate applied to the hidden
+projection of the candidate."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+from . import functional as F
+from .layer import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class RNNCellBase(Layer):
+    """Base: parameter creation + default initial states."""
+
+    def _create(self, hidden_size, input_size, gates):
+        k = 1.0 / math.sqrt(hidden_size)
+        from .initializer import Uniform
+
+        init = Uniform(-k, k)
+        self.weight_ih = self.create_parameter(
+            (gates * hidden_size, input_size), default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (gates * hidden_size, hidden_size), default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            (gates * hidden_size,), is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            (gates * hidden_size,), is_bias=True, default_initializer=init)
+
+    def get_initial_states(self, batch, dtype=jnp.float32):
+        shape = (batch, self.hidden_size)
+        if getattr(self, "state_is_tuple", False):
+            return (Tensor._wrap(jnp.zeros(shape, dtype)),
+                    Tensor._wrap(jnp.zeros(shape, dtype)))
+        return Tensor._wrap(jnp.zeros(shape, dtype))
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh); act in tanh/relu."""
+
+    state_is_tuple = False
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if activation not in ("tanh", "relu"):
+            raise ValueError("SimpleRNNCell activation: tanh | relu")
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self.activation = activation
+        self._create(hidden_size, input_size, 1)
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh, activation="tanh"):
+        pre = x @ wih.T + bih + h @ whh.T + bhh
+        return jnp.tanh(pre) if activation == "tanh" else jax.nn.relu(pre)
+
+    def forward(self, inputs, states=None):
+        h = (states if states is not None
+             else self.get_initial_states(_arr(inputs).shape[0]))
+
+        def fn(x, hh, wih, whh, bih, bhh):
+            return self._step(x, hh, wih, whh, bih, bhh, self.activation)
+
+        out = apply_op(fn, inputs, h, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh)
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    """Gate chunks [i, f, g, o] (the reference/cuDNN order)."""
+
+    state_is_tuple = True
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._create(hidden_size, input_size, 4)
+
+    @staticmethod
+    def _step(x, h, c, wih, whh, bih, bhh):
+        hs = h.shape[-1]
+        pre = x @ wih.T + bih + h @ whh.T + bhh
+        i = jax.nn.sigmoid(pre[..., 0 * hs:1 * hs])
+        f = jax.nn.sigmoid(pre[..., 1 * hs:2 * hs])
+        g = jnp.tanh(pre[..., 2 * hs:3 * hs])
+        o = jax.nn.sigmoid(pre[..., 3 * hs:4 * hs])
+        c2 = f * c + i * g
+        return o * jnp.tanh(c2), c2
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(_arr(inputs).shape[0])
+        h, c = states
+
+        def fn(x, hh, cc, wih, whh, bih, bhh):
+            return jnp.stack(self._step(x, hh, cc, wih, whh, bih, bhh))
+
+        both = apply_op(fn, inputs, h, c, self.weight_ih, self.weight_hh,
+                        self.bias_ih, self.bias_hh)
+        h2 = apply_op(lambda b: b[0], both)
+        c2 = apply_op(lambda b: b[1], both)
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    """Gate chunks [r, z, c~]; h' = z*h + (1-z)*c~ with the reset gate on
+    the candidate's hidden projection (the reference formulation)."""
+
+    state_is_tuple = False
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size, self.hidden_size = input_size, hidden_size
+        self._create(hidden_size, input_size, 3)
+
+    @staticmethod
+    def _step(x, h, wih, whh, bih, bhh):
+        hs = h.shape[-1]
+        gi = x @ wih.T + bih
+        gh = h @ whh.T + bhh
+        r = jax.nn.sigmoid(gi[..., :hs] + gh[..., :hs])
+        z = jax.nn.sigmoid(gi[..., hs:2 * hs] + gh[..., hs:2 * hs])
+        cand = jnp.tanh(gi[..., 2 * hs:] + r * gh[..., 2 * hs:])
+        return z * h + (1.0 - z) * cand
+
+    def forward(self, inputs, states=None):
+        h = (states if states is not None
+             else self.get_initial_states(_arr(inputs).shape[0]))
+        out = apply_op(self._step, inputs, h, self.weight_ih,
+                       self.weight_hh, self.bias_ih, self.bias_hh)
+        return out, out
+
+
+def _scan_layer_params(cell, xs, h0, reverse, params):
+    """One direction of one layer as a single lax.scan over time.
+    ``xs``: [T, B, I] raw array; states are raw arrays/tuples; params
+    are traced operands so weight gradients flow through apply_op."""
+    wih, whh, bih, bhh = params
+    if isinstance(cell, LSTMCell):
+        def body(carry, x):
+            h, c = carry
+            h2, c2 = LSTMCell._step(x, h, c, wih, whh, bih, bhh)
+            return (h2, c2), h2
+    elif isinstance(cell, GRUCell):
+        def body(carry, x):
+            h2 = GRUCell._step(x, carry, wih, whh, bih, bhh)
+            return h2, h2
+    else:
+        act = cell.activation
+
+        def body(carry, x):
+            h2 = SimpleRNNCell._step(x, carry, wih, whh, bih, bhh, act)
+            return h2, h2
+
+    final, ys = jax.lax.scan(body, h0, xs, reverse=reverse)
+    return ys, final
+
+
+class RNN(Layer):
+    """Run ``cell`` over a sequence with one compiled scan (reference:
+    paddle.nn.RNN(cell, is_reverse, time_major))."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = bool(is_reverse)
+        self.time_major = bool(time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "RNN: ragged sequence_length not supported; pad + mask")
+        cell = self.cell
+        tm = self.time_major
+        rev = self.is_reverse
+        batch_axis = 0 if tm else 1
+
+        if initial_states is None:
+            b = _arr(inputs).shape[1 if tm else 0]
+            initial_states = cell.get_initial_states(b)
+        tup = isinstance(initial_states, (tuple, list))
+        state_args = list(initial_states) if tup else [initial_states]
+        wts = [cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh]
+
+        def fn(x, *rest):
+            states, (wih, whh, bih, bhh) = rest[:-4], rest[-4:]
+            xs = x if tm else jnp.swapaxes(x, 0, 1)
+            h0 = tuple(states) if tup else states[0]
+            ys, final = _scan_layer_params(
+                cell, xs, h0, rev, (wih, whh, bih, bhh))
+            if not tm:
+                ys = jnp.swapaxes(ys, 0, 1)
+            if tup:
+                return (ys,) + tuple(final)
+            return ys, final
+
+        outs = apply_op(fn, inputs, *state_args, *wts)
+        if tup:
+            return outs[0], (outs[1], outs[2])
+        return outs[0], outs[1]
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over the same input, outputs concatenated
+    (reference: paddle.nn.BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_f, st_b = (initial_states if initial_states is not None
+                      else (None, None))
+        y_f, s_f = self.rnn_fw(inputs, st_f, sequence_length)
+        y_b, s_b = self.rnn_bw(inputs, st_b, sequence_length)
+        y = apply_op(lambda a, b: jnp.concatenate([a, b], -1), y_f, y_b)
+        return y, (s_f, s_b)
+
+
+class _RNNBase(Layer):
+    """Stacked (optionally bidirectional) recurrent network."""
+
+    _CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, **kw):
+        super().__init__()
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError("direction: forward | bidirect")
+        self.bidirect = direction != "forward"
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = float(dropout)
+        self.hidden_size = hidden_size
+        from .layer import LayerList
+
+        mk = (lambda i: self._cell(i, hidden_size, activation))
+        widths = [input_size] + [
+            hidden_size * (2 if self.bidirect else 1)] * (num_layers - 1)
+        self.fw = LayerList([mk(w) for w in widths])
+        self.bw = (LayerList([mk(w) for w in widths])
+                   if self.bidirect else None)
+
+    def _cell(self, inp, hid, activation):
+        if activation is not None:
+            return type(self)._CELL(inp, hid, activation=activation)
+        return type(self)._CELL(inp, hid)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "ragged sequence_length not supported; pad + mask")
+        y = inputs
+        finals = []
+        for li in range(self.num_layers):
+            if self.bidirect:
+                layer = BiRNN(self.fw[li], self.bw[li],
+                              time_major=self.time_major)
+                y, (s_f, s_b) = layer(y)
+                finals.append((s_f, s_b))
+            else:
+                layer = RNN(self.fw[li], time_major=self.time_major)
+                y, s = layer(y)
+                finals.append(s)
+            if self.dropout and self.training and li < self.num_layers - 1:
+                y = F.dropout(y, p=self.dropout, training=True)
+        return y, finals
+
+
+class SimpleRNN(_RNNBase):
+    _CELL = SimpleRNNCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation)
+
+
+class LSTM(_RNNBase):
+    _CELL = LSTMCell
+
+
+class GRU(_RNNBase):
+    _CELL = GRUCell
+
+
+class BeamSearchDecoder(Layer):
+    """Beam-search decoding over an RNN cell (reference:
+    paddle.nn.BeamSearchDecoder + paddle.nn.dynamic_decode).
+
+    Host-driven eager loop (the legacy seq2seq API surface — the modern
+    generation path is models/generation.py's compiled scan): each step
+    embeds the live tokens, advances the cell for every (batch, beam)
+    hypothesis, applies ``output_fn`` for vocab logits, and keeps the
+    top ``beam_size`` continuations by cumulative log-prob. Finished
+    beams (end_token) are frozen with a one-hot distribution so their
+    scores stop changing."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        super().__init__()
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _logits(self, cell_out):
+        out = (self.output_fn(cell_out) if self.output_fn is not None
+               else cell_out)
+        return jax.nn.log_softmax(_arr(out).astype(jnp.float32), -1)
+
+    def decode(self, initial_states=None, batch=1, max_step_num=32):
+        """Returns (token ids [batch, beam, T], scores [batch, beam])."""
+        bs, k = batch, self.beam_size
+        tup = getattr(self.cell, "state_is_tuple", False)
+
+        def tile(s):
+            a = _arr(s)
+            return jnp.repeat(a, k, axis=0)  # [bs*k, H]
+
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(bs)
+        states = (tuple(tile(s) for s in initial_states) if tup
+                  else tile(initial_states))
+        tokens = np.full((bs, k), self.start_token, np.int64)
+        # beam 0 starts live, others at -inf so step 1 fans from one beam
+        scores = np.full((bs, k), -1e9, np.float32)
+        scores[:, 0] = 0.0
+        finished = np.zeros((bs, k), bool)
+        history = []
+        for _ in range(max_step_num):
+            if finished.all():
+                break
+            tok_t = Tensor._wrap(jnp.asarray(tokens.reshape(-1)))
+            emb = (self.embedding_fn(tok_t) if self.embedding_fn
+                   else Tensor._wrap(jax.nn.one_hot(
+                       _arr(tok_t), self.cell.input_size,
+                       dtype=jnp.float32)))
+            st_in = (tuple(Tensor._wrap(s) for s in states) if tup
+                     else Tensor._wrap(states))
+            out, new_states = self.cell(emb, st_in)
+            logp = np.asarray(self._logits(out)).reshape(bs, k, -1)
+            v = logp.shape[-1]
+            # frozen finished beams: only end_token continues, at 0 cost
+            # (an end_token outside the vocab means "never finishes" —
+            # e.g. a fixed-length decode — and nothing to freeze)
+            if 0 <= self.end_token < v:
+                frozen = np.full((bs, k, v), -1e9, np.float32)
+                frozen[:, :, self.end_token] = 0.0
+                logp = np.where(finished[:, :, None], frozen, logp)
+            total = scores[:, :, None] + logp  # [bs, k, v]
+            flat = total.reshape(bs, -1)
+            top = np.argsort(-flat, axis=-1)[:, :k]
+            scores = np.take_along_axis(flat, top, -1)
+            beam_src = top // v
+            tokens = (top % v).astype(np.int64)
+            finished = np.take_along_axis(finished, beam_src, 1) | (
+                tokens == self.end_token)
+            # reorder states + history by the source beam of each winner
+            gather = (beam_src + np.arange(bs)[:, None] * k).reshape(-1)
+            g = jnp.asarray(gather)
+
+            def pick(s):
+                return _arr(s)[g]
+
+            states = (tuple(pick(s) for s in new_states) if tup
+                      else pick(new_states))
+            history = [h[np.arange(bs)[:, None], beam_src]
+                       for h in history]
+            history.append(tokens.copy())
+        ids = np.stack(history, axis=-1) if history else np.zeros(
+            (bs, k, 0), np.int64)
+        return ids, scores
+
+    def forward(self, initial_states=None, batch=1, max_step_num=32):
+        return self.decode(initial_states, batch, max_step_num)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, batch=1, **kw):
+    """Reference: paddle.nn.dynamic_decode(decoder, inits, max_step_num)."""
+    return decoder.decode(inits, batch=batch, max_step_num=max_step_num)
+
+
+__all__.extend(["BeamSearchDecoder", "dynamic_decode"])

@@ -1,0 +1,317 @@
+"""nn layer-surface tail + RNN family (r5; reference:
+python/paddle/nn/layer/rnn.py + the wrapper layers). LSTM/GRU cell math
+cross-checked against torch (same cuDNN gate conventions) with copied
+weights; wrappers twin-checked against numpy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework.tensor import Tensor
+
+
+def _f(t):
+    return np.asarray(t)
+
+
+class TestRNNCellsVsTorch:
+    def _copy_cell(self, ours, theirs):
+        import torch
+
+        with torch.no_grad():
+            theirs.weight_ih.copy_(torch.tensor(_f(ours.weight_ih)))
+            theirs.weight_hh.copy_(torch.tensor(_f(ours.weight_hh)))
+            theirs.bias_ih.copy_(torch.tensor(_f(ours.bias_ih)))
+            theirs.bias_hh.copy_(torch.tensor(_f(ours.bias_hh)))
+
+    def test_lstm_cell_matches_torch(self, rng):
+        import torch
+
+        paddle.seed(0)
+        cell = nn.LSTMCell(6, 5)
+        tcell = torch.nn.LSTMCell(6, 5)
+        self._copy_cell(cell, tcell)
+        x = rng.standard_normal((3, 6)).astype(np.float32)
+        h0 = rng.standard_normal((3, 5)).astype(np.float32)
+        c0 = rng.standard_normal((3, 5)).astype(np.float32)
+        out, (h, c) = cell(Tensor(x), (Tensor(h0), Tensor(c0)))
+        th, tc = tcell(torch.tensor(x), (torch.tensor(h0),
+                                         torch.tensor(c0)))
+        np.testing.assert_allclose(_f(h), th.detach().numpy(), atol=1e-5)
+        np.testing.assert_allclose(_f(c), tc.detach().numpy(), atol=1e-5)
+
+    def test_gru_cell_matches_torch(self, rng):
+        import torch
+
+        paddle.seed(1)
+        cell = nn.GRUCell(6, 5)
+        tcell = torch.nn.GRUCell(6, 5)
+        self._copy_cell(cell, tcell)
+        x = rng.standard_normal((3, 6)).astype(np.float32)
+        h0 = rng.standard_normal((3, 5)).astype(np.float32)
+        out, h = cell(Tensor(x), Tensor(h0))
+        th = tcell(torch.tensor(x), torch.tensor(h0))
+        np.testing.assert_allclose(_f(h), th.detach().numpy(), atol=1e-5)
+
+    def test_simple_rnn_cell(self, rng):
+        paddle.seed(2)
+        cell = nn.SimpleRNNCell(4, 3)
+        x = rng.standard_normal((2, 4)).astype(np.float32)
+        h0 = rng.standard_normal((2, 3)).astype(np.float32)
+        out, h = cell(Tensor(x), Tensor(h0))
+        expect = np.tanh(x @ _f(cell.weight_ih).T + _f(cell.bias_ih)
+                         + h0 @ _f(cell.weight_hh).T + _f(cell.bias_hh))
+        np.testing.assert_allclose(_f(h), expect, atol=1e-5)
+
+
+class TestRNNNetworks:
+    def test_rnn_wrapper_equals_stepped_cell(self, rng):
+        paddle.seed(3)
+        cell = nn.GRUCell(4, 6)
+        net = nn.RNN(cell)
+        x = rng.standard_normal((2, 5, 4)).astype(np.float32)
+        ys, hn = net(Tensor(x))
+        # step the same cell by hand
+        h = np.zeros((2, 6), np.float32)
+        for t in range(5):
+            _, h_t = cell(Tensor(x[:, t]), Tensor(h))
+            h = _f(h_t)
+            np.testing.assert_allclose(_f(ys)[:, t], h, atol=1e-5)
+        np.testing.assert_allclose(_f(hn), h, atol=1e-5)
+
+    def test_lstm_network_shapes_and_grad(self, rng):
+        paddle.seed(4)
+        net = nn.LSTM(8, 16, num_layers=2, direction="bidirect")
+        x = Tensor(rng.standard_normal((3, 7, 8)).astype(np.float32))
+        x.stop_gradient = False
+        y, finals = net(x)
+        assert _f(y).shape == (3, 7, 32)
+        assert len(finals) == 2  # per layer: (fw_state, bw_state)
+        loss = y.pow(2).mean()
+        loss.backward()
+        assert x.grad is not None
+        gnorms = [np.linalg.norm(_f(p.grad)) for p in net.parameters()
+                  if p.grad is not None]
+        assert len(gnorms) == 16 and all(np.isfinite(g) for g in gnorms)
+
+    def test_reverse_direction(self, rng):
+        paddle.seed(5)
+        cell = nn.SimpleRNNCell(4, 3)
+        fwd = nn.RNN(cell)
+        rev = nn.RNN(cell, is_reverse=True)
+        x = rng.standard_normal((1, 6, 4)).astype(np.float32)
+        y_r, _ = rev(Tensor(x))
+        y_f, _ = fwd(Tensor(x[:, ::-1]))
+        np.testing.assert_allclose(_f(y_r), _f(y_f)[:, ::-1], atol=1e-5)
+
+    def test_time_major(self, rng):
+        paddle.seed(6)
+        cell = nn.GRUCell(4, 3)
+        tm = nn.RNN(cell, time_major=True)
+        bm = nn.RNN(cell, time_major=False)
+        x = rng.standard_normal((5, 2, 4)).astype(np.float32)
+        y_tm, _ = tm(Tensor(x))
+        y_bm, _ = bm(Tensor(x.transpose(1, 0, 2)))
+        np.testing.assert_allclose(_f(y_tm), _f(y_bm).transpose(1, 0, 2),
+                                   atol=1e-5)
+
+
+class TestWrapperLayers:
+    def test_pixel_ops_roundtrip(self, rng):
+        x = rng.standard_normal((2, 8, 4, 4)).astype(np.float32)
+        up = nn.PixelShuffle(2)(Tensor(x))
+        back = nn.PixelUnshuffle(2)(up)
+        np.testing.assert_allclose(_f(back), x, atol=1e-6)
+        sh = nn.ChannelShuffle(2)(Tensor(x))
+        assert _f(sh).shape == x.shape
+        assert not np.allclose(_f(sh), x)
+
+    def test_pool3d_and_adaptive(self, rng):
+        x = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        out = nn.MaxPool3D(2)(Tensor(x))
+        expect = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+        np.testing.assert_allclose(_f(out), expect, atol=1e-6)
+        out = nn.AvgPool3D(2)(Tensor(x))
+        np.testing.assert_allclose(
+            _f(out), x.reshape(1, 2, 2, 2, 2, 2, 2, 2).mean((3, 5, 7)),
+            atol=1e-6)
+        out = nn.AdaptiveAvgPool3D(2)(Tensor(x))
+        assert _f(out).shape == (1, 2, 2, 2, 2)
+
+    def test_unpool_roundtrip(self, rng):
+        from paddle_tpu.nn import functional as F
+
+        x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+        out, idx = F.max_pool2d_with_indices(Tensor(x), 2)
+        rec = nn.MaxUnPool2D(2)(out, idx)
+        # recovered map has the max at its original position, zeros else
+        assert _f(rec).shape == x.shape
+        np.testing.assert_allclose(_f(rec).max((2, 3)),
+                                   x.reshape(1, 2, -1).max(-1), atol=1e-6)
+
+    def test_conv_transposes_invert_shape(self, rng):
+        x = rng.standard_normal((1, 3, 8)).astype(np.float32)
+        ct1 = nn.Conv1DTranspose(3, 5, kernel_size=4, stride=2, padding=1)
+        y = ct1(Tensor(x))
+        assert _f(y).shape == (1, 5, 16)
+        x3 = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+        ct3 = nn.Conv3DTranspose(2, 3, kernel_size=2, stride=2)
+        assert _f(ct3(Tensor(x3))).shape == (1, 3, 8, 8, 8)
+
+    def test_conv1d_transpose_matches_torch(self, rng):
+        import torch
+
+        paddle.seed(8)
+        ours = nn.Conv1DTranspose(3, 5, kernel_size=3, stride=2,
+                                  padding=1, output_padding=1)
+        theirs = torch.nn.ConvTranspose1d(3, 5, 3, stride=2, padding=1,
+                                          output_padding=1)
+        with torch.no_grad():
+            theirs.weight.copy_(torch.tensor(_f(ours.weight)))
+            theirs.bias.copy_(torch.tensor(_f(ours.bias)))
+        x = rng.standard_normal((2, 3, 7)).astype(np.float32)
+        np.testing.assert_allclose(
+            _f(ours(Tensor(x))),
+            theirs(torch.tensor(x)).detach().numpy(), atol=1e-4)
+
+    def test_losses_twin(self, rng):
+        a = rng.standard_normal((4, 5)).astype(np.float32)
+        b = rng.standard_normal((4, 5)).astype(np.float32)
+        hub = float(_f(nn.HuberLoss(delta=1.0)(Tensor(a), Tensor(b))))
+        d = a - b
+        expect = np.where(np.abs(d) <= 1, 0.5 * d * d,
+                          np.abs(d) - 0.5).mean()
+        assert hub == pytest.approx(expect, rel=1e-5)
+        y = np.sign(rng.standard_normal((4, 5))).astype(np.float32)
+        sm = float(_f(nn.SoftMarginLoss()(Tensor(a), Tensor(y))))
+        assert sm == pytest.approx(np.log1p(np.exp(-y * a)).mean(),
+                                   rel=1e-5)
+        anchor, pos, neg = (rng.standard_normal((3, 6)).astype(np.float32)
+                            for _ in range(3))
+        tm = float(_f(nn.TripletMarginLoss()(Tensor(anchor), Tensor(pos),
+                                             Tensor(neg))))
+        dp = np.linalg.norm(anchor - pos + 1e-6, axis=-1)
+        dn = np.linalg.norm(anchor - neg + 1e-6, axis=-1)
+        assert tm == pytest.approx(np.maximum(dp - dn + 1, 0).mean(),
+                                   rel=1e-4)
+        lam = np.abs(rng.standard_normal((4,)).astype(np.float32)) + 0.1
+        pn = float(_f(nn.PoissonNLLLoss()(Tensor(a[:, 0]),
+                                          Tensor(lam))))
+        assert pn == pytest.approx(
+            (np.exp(a[:, 0]) - lam * a[:, 0]).mean(), rel=1e-5)
+
+    def test_instance_norm_normalizes(self, rng):
+        x = (rng.standard_normal((2, 3, 16)) * 4 + 2).astype(np.float32)
+        out = _f(nn.InstanceNorm1D(3)(Tensor(x)))
+        np.testing.assert_allclose(out.mean(-1), 0.0, atol=1e-4)
+        np.testing.assert_allclose(out.std(-1), 1.0, atol=1e-2)
+
+    def test_pads_unflatten_upsample(self, rng):
+        x = rng.standard_normal((1, 2, 4)).astype(np.float32)
+        assert _f(nn.Pad1D([1, 2])(Tensor(x))).shape == (1, 2, 7)
+        x2 = rng.standard_normal((1, 2, 3, 3)).astype(np.float32)
+        assert _f(nn.ZeroPad2D(1)(Tensor(x2))).shape == (1, 2, 5, 5)
+        x5 = rng.standard_normal((1, 2, 2, 2, 2)).astype(np.float32)
+        assert _f(nn.Pad3D(1)(Tensor(x5))).shape == (1, 2, 4, 4, 4)
+        u = nn.Unflatten(1, [2, 1])(Tensor(x))
+        assert _f(u).shape == (1, 2, 1, 4)
+        up = nn.UpsamplingNearest2D(scale_factor=2)(Tensor(x2))
+        assert _f(up).shape == (1, 2, 6, 6)
+
+    def test_fold_inverts_unfold(self, rng):
+        from paddle_tpu.nn import functional as F
+
+        x = rng.standard_normal((1, 2, 4, 4)).astype(np.float32)
+        cols = F.unfold(Tensor(x), 2, strides=2)
+        rec = nn.Fold([4, 4], 2, strides=2)(cols)
+        np.testing.assert_allclose(_f(rec), x, atol=1e-6)
+
+    def test_spectral_norm_unit_sigma(self, rng):
+        w = rng.standard_normal((6, 4)).astype(np.float32)
+        sn = nn.SpectralNorm(w.shape, power_iters=30)
+        out = _f(sn(Tensor(w)))
+        assert np.linalg.norm(out, 2) == pytest.approx(1.0, rel=1e-3)
+
+    def test_layerdict(self):
+        ld = nn.LayerDict({"fc1": nn.Linear(2, 3)})
+        ld["fc2"] = nn.Linear(3, 4)
+        assert set(ld.keys()) == {"fc1", "fc2"}
+        assert len(list(ld.parameters())) == 4
+        popped = ld.pop("fc1")
+        assert isinstance(popped, nn.Linear) and "fc1" not in ld
+
+    def test_misc_activations(self, rng):
+        x = rng.standard_normal((3, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            _f(nn.LogSigmoid()(Tensor(x))),
+            np.log(1 / (1 + np.exp(-x))), atol=1e-5)
+        mo = _f(nn.Maxout(2, axis=1)(Tensor(x)))
+        assert mo.shape == (3, 4)
+        r = nn.RReLU()
+        r.eval()
+        mid = (1 / 8 + 1 / 3) / 2
+        np.testing.assert_allclose(
+            _f(r(Tensor(x))), np.where(x >= 0, x, x * mid), atol=1e-5)
+        gs = nn.GumbelSoftmax(hard=True)
+        out = _f(gs(Tensor(x)))
+        np.testing.assert_allclose(out.sum(-1), 1.0, atol=1e-5)
+
+
+class TestBeamSearch:
+    def test_beam_search_beats_greedy_and_matches_bruteforce(self, rng):
+        """Tiny deterministic cell: beam search over 3 steps must return
+        exactly the top-k sequences by total log-prob (brute force)."""
+        import itertools
+
+        paddle.seed(9)
+        V = 5
+        cell = nn.SimpleRNNCell(V, V)
+        proj = nn.Linear(V, V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=V + 9,
+                                   beam_size=3, output_fn=proj)
+        ids, scores = dec.decode(batch=1, max_step_num=3)
+        assert ids.shape == (1, 3, 3) and scores.shape == (1, 3)
+
+        # brute force over all 3-step sequences with the same cell
+        import jax
+        import jax.numpy as jnp
+
+        def run_seq(seq):
+            h = np.zeros((1, V), np.float32)
+            tot = 0.0
+            tok = 0
+            for t, nxt in enumerate(seq):
+                emb = jax.nn.one_hot(jnp.asarray([tok]), V,
+                                     dtype=jnp.float32)
+                out, h_t = cell(Tensor(emb), Tensor(h))
+                h = np.asarray(h_t._data)
+                logp = np.asarray(
+                    jax.nn.log_softmax(proj(out)._data, -1))[0]
+                tot += logp[nxt]
+                tok = nxt
+            return tot
+
+        best = sorted(
+            (run_seq(s), s) for s in itertools.product(range(V),
+                                                       repeat=3))[::-1][:3]
+        got = [tuple(ids[0, i]) for i in range(3)]
+        want = [s for _, s in best]
+        assert got == want, (got, want)
+        np.testing.assert_allclose(
+            sorted(scores[0])[::-1], sorted(
+                [v for v, _ in best])[::-1], rtol=1e-4)
+
+    def test_end_token_freezes_beam(self, rng):
+        paddle.seed(10)
+        V = 4
+        cell = nn.GRUCell(V, V)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=2)
+        ids, scores = dec.decode(batch=2, max_step_num=6)
+        # any beam that emitted end_token must stay on end_token after
+        for b in range(2):
+            for k in range(2):
+                seq = list(ids[b, k])
+                if 1 in seq:
+                    i = seq.index(1)
+                    assert all(t == 1 for t in seq[i:])

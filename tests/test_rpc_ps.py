@@ -139,3 +139,81 @@ def test_ps_one_server_two_trainers():
     assert "PS_SERVER_DONE" in outs[0]
     assert "PS_TRAINER_DONE 1" in outs[1]
     assert "PS_TRAINER_DONE 2" in outs[2]
+
+
+class TestGeoAndServerOptimizers:
+    def test_geo_mode_converges_with_less_communication(self):
+        """Two in-process GeoTrainers against one geo server: local SGD
+        for k_steps, delta push + merged pull. The merged parameter must
+        incorporate both trainers' progress."""
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed.ps import GeoTrainer, ParameterServer
+
+        srv = ParameterServer(optimizer="geo")
+        k = 4
+
+        def make_worker(seed):
+            paddle.seed(0)  # same init on every worker (geo contract)
+            m = nn.Linear(4, 3)
+            opt = optimizer.SGD(learning_rate=0.1,
+                                parameters=m.parameters())
+            geo = GeoTrainer(m, k_steps=k, push=srv.push_dense,
+                             pull=srv.pull_dense,
+                             register=srv.register_dense)
+            rng = np.random.default_rng(seed)
+            return m, opt, geo, rng
+
+        workers = [make_worker(1), make_worker(2)]
+        base = srv.pull_dense("weight")
+        syncs = 0
+        for step in range(2 * k):
+            for m, opt, geo, rng in workers:
+                x = paddle.to_tensor(
+                    rng.standard_normal((6, 4)).astype(np.float32))
+                y = paddle.to_tensor(rng.integers(0, 3, (6,)))
+                loss = nn.functional.cross_entropy(m(x), y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                syncs += geo.maybe_sync()
+        assert syncs == 2 * 2  # each worker synced twice, not 2*k times
+        merged = srv.pull_dense("weight")
+        assert not np.allclose(merged, base)  # both deltas landed
+        # every worker converged to the server's merged value at its sync
+        for m, _, geo, _ in workers:
+            np.testing.assert_allclose(
+                geo._snap["weight"],
+                np.asarray([p._data for n, p in m.named_parameters()
+                            if n == "weight"][0]), rtol=1e-6)
+
+    def test_adam_server_update(self):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps import ParameterServer
+
+        srv = ParameterServer(lr=0.1, optimizer="adam")
+        srv.register_dense("w", np.zeros(3, np.float32))
+        g = np.array([1.0, -1.0, 2.0], np.float32)
+        srv.push_dense("w", g)
+        # first Adam step: p -= lr * sign-ish(g)
+        w = srv.pull_dense("w")
+        np.testing.assert_allclose(w, -0.1 * np.sign(g), atol=1e-4)
+        # sparse adam: rows move opposite the gradient
+        srv.push_sparse("emb", [3, 3], np.ones((2, 8), np.float32))
+        row = srv.pull_sparse("emb", [3])[0]
+        assert (row < srv.pull_sparse("emb", [5])[0] + 1).all()
+
+    def test_geo_sparse_delta(self):
+        import numpy as np
+
+        from paddle_tpu.distributed.ps import ParameterServer
+
+        srv = ParameterServer(optimizer="geo", sparse_dim=4)
+        before = srv.pull_sparse("emb", [7])[0].copy()
+        delta = np.full((1, 4), 0.5, np.float32)
+        srv.push_sparse("emb", [7], delta)
+        after = srv.pull_sparse("emb", [7])[0]
+        np.testing.assert_allclose(after, before + 0.5, rtol=1e-6)

@@ -20,6 +20,13 @@ keeps exactly that capability, over the framework's own Python RPC layer
 
 Roles follow the reference's env contract: ``PADDLE_TRAINING_ROLE``
 (``PSERVER``/``TRAINER``), with explicit args taking precedence.
+
+Server optimizers: sgd, adagrad, adam, and geo (delta-sum for the
+GeoTrainer's k_steps local-training mode). Recorded remaining gaps vs the
+reference's full PS stack: no SSD-backed tables, no ctr accessor
+feature-frequency eviction, and the transport is pickle-over-TCP rather
+than brpc — the recorded-capability floor for recommender workloads, not
+a production PS.
 """
 from __future__ import annotations
 

@@ -53,6 +53,7 @@ from . import optimizer  # noqa: F401
 import importlib as _importlib
 
 _LAZY = {
+    "analysis": "paddle_tpu.analysis",
     "io": "paddle_tpu.io",
     "jit": "paddle_tpu.jit",
     "vision": "paddle_tpu.vision",

@@ -1,0 +1,24 @@
+"""paddle_tpu.analysis — tpulint: trace-safety tooling for the compiled path.
+
+Static side (pure stdlib, no jax import): an AST linter that finds
+jit-breaking and recompile-forcing patterns — host syncs, impure RNG,
+tensor-dependent branching, trace-escaping side effects — before they reach
+the chip. Run it via ``make lint`` / ``python tools/lint_tpu.py <paths>``,
+or programmatically:
+
+    from paddle_tpu.analysis import lint_paths
+    result = lint_paths(["paddle_tpu", "examples"])
+    assert not result.violations
+
+Runtime side: :func:`leak_guard` arms ``jax.check_tracer_leaks`` around a
+compiled-path entry (opt-in via ``PADDLE_TPU_CHECK_TRACERS=1``).
+"""
+from .linter import LintResult, Violation, lint_file, lint_paths, lint_source  # noqa: F401
+from .rules import FAMILIES, RULES, Rule  # noqa: F401
+from .runtime import TracerLeakError, leak_guard, tracer_checks_enabled  # noqa: F401
+
+__all__ = [
+    "LintResult", "Violation", "lint_file", "lint_paths", "lint_source",
+    "RULES", "Rule", "FAMILIES",
+    "leak_guard", "tracer_checks_enabled", "TracerLeakError",
+]

@@ -1,0 +1,80 @@
+"""tpulint command line (invoked via ``tools/lint_tpu.py`` / ``make lint``).
+
+Text output is one ``path:line:col: RULE message`` per violation —
+grep/editor-jump friendly. ``--format json`` emits a machine-readable list
+for CI annotation. Exit codes: 0 clean (or violations found but
+``--fail-on-violation`` not given), 1 violations with
+``--fail-on-violation``, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from . import linter
+from .rules import RULES
+
+
+def _list_rules() -> str:
+    out = []
+    fam = None
+    for rule in sorted(RULES.values(), key=lambda r: r.id):
+        if rule.family != fam:
+            fam = rule.family
+            out.append(f"\n[{fam}]")
+        out.append(f"  {rule.id}  {rule.name}\n      {rule.description}")
+    return "\n".join(out).strip()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="lint_tpu",
+        description="tpulint — trace-safety static analysis for paddle_tpu. "
+                    "Suppress a finding with `# tpulint: disable=TPLxxx -- "
+                    "reason` on (or directly above) the offending line.")
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument("--fail-on-violation", action="store_true",
+                    help="exit 1 if any unsuppressed violation is found")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed violations")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalogue and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("lint_tpu: error: no paths given", file=sys.stderr)
+        return 2
+
+    result = linter.lint_paths(args.paths)
+
+    if args.format == "json":
+        payload = {
+            "files_scanned": result.files_scanned,
+            "violations": [vars(v) for v in result.violations],
+            "suppressed": [vars(v) for v in result.suppressed],
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for v in result.violations:
+            print(v.format())
+        if args.show_suppressed:
+            for v in result.suppressed:
+                print(v.format())
+        n, s = len(result.violations), len(result.suppressed)
+        print(f"tpulint: {result.files_scanned} files, "
+              f"{n} violation{'s' if n != 1 else ''}, {s} suppressed")
+
+    if args.fail_on_violation and result.violations:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

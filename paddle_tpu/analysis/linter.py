@@ -1,0 +1,734 @@
+"""tpulint core: AST-based trace-safety analysis for the compiled path.
+
+The whole framework bet (SURVEY §3.4) is that a training step is ONE
+``jax.jit`` program. The analysis therefore centers on *traced code*:
+
+1. **Root discovery** — functions that enter a trace: decorated with
+   ``@to_static`` / ``@jax.jit`` (possibly via ``functools.partial``), or
+   passed by name into a tracing wrapper (``jax.jit(f)``, ``to_static(f)``,
+   ``lax.scan(body, ...)``, ``shard_map(f, ...)``, ``pl.pallas_call(k)``…).
+2. **Closure** — a function called by bare name (or ``self.m()``) from a
+   traced function is traced too; functions lexically nested inside a
+   traced function are traced. Fixpoint over the intra-module call graph.
+   (Cross-module reachability — e.g. the Layer whose ``forward`` a
+   ``functional_call`` site traces — is intentionally out of scope: each
+   module is analyzed against its own roots, which in practice covers the
+   layer library because its forwards are reached from in-module jit/scan
+   roots.)
+3. **Taint** — inside a traced function, parameters are tracers. A cheap
+   flow pass propagates "tensor-derived" through assignments, loops and
+   calls, while shape/dtype/len()-style accesses stay static. Rules that
+   need to know whether a value is a tracer (branching, casts, printing)
+   consult the taint set; structural rules (.numpy() under trace, RNG
+   calls) do not.
+
+Pure stdlib — importing this module must never pull in jax.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import rules as R
+
+__all__ = ["Violation", "LintResult", "lint_source", "lint_file", "lint_paths"]
+
+
+# --------------------------------------------------------------------- model
+
+
+@dataclass
+class Violation:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = ""
+
+    def format(self) -> str:
+        tag = " [suppressed]" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}{tag}"
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation] = field(default_factory=list)  # unsuppressed
+    suppressed: List[Violation] = field(default_factory=list)
+    files_scanned: int = 0
+
+    def extend(self, other: "LintResult"):
+        self.violations.extend(other.violations)
+        self.suppressed.extend(other.suppressed)
+        self.files_scanned += other.files_scanned
+
+
+# ------------------------------------------------------------- trace roots
+
+# Callables/decorators that trace their function argument straight into XLA.
+_TRACING_WRAPPERS = {
+    "jit", "pjit", "to_static", "pmap", "vmap", "xmap", "grad",
+    "value_and_grad", "jacfwd", "jacrev", "hessian", "checkpoint", "remat",
+    "scan", "while_loop", "fori_loop", "cond", "switch", "associated_scan",
+    "associative_scan", "shard_map", "pallas_call", "custom_vjp",
+    "custom_jvp", "linearize", "vjp", "jvp", "make_jaxpr", "eval_shape",
+    "named_call",
+}
+
+# Attribute accesses that stay static under trace (shape metadata).
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "name", "size", "place"}
+# Calls whose result is static regardless of argument taint. "dtype" covers
+# jnp.dtype(x)/np.dtype(x) metadata constructors.
+_STATIC_CALLS = {"len", "isinstance", "type", "id", "hasattr", "getattr",
+                 "callable", "range", "dtype"}
+# Methods whose result is static (python-int metadata on Tensor).
+_STATIC_METHODS = {"dim", "numel"}
+
+_MUTATOR_METHODS = {"append", "extend", "insert", "add", "update",
+                    "setdefault", "remove", "discard", "clear", "popitem",
+                    "appendleft", "extendleft"}
+
+_MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                     ast.SetComp)
+
+_CORE_ALIASES = {"np", "jnp", "jax", "lax"}
+
+
+def _tail_name(node: ast.AST) -> Optional[str]:
+    """Last dotted component of a Name/Attribute/Call-func expression."""
+    if isinstance(node, ast.Call):
+        return _tail_name(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for an Attribute chain rooted at a Name, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_tracing_expr(node: ast.AST) -> bool:
+    """Does this decorator/callee expression denote a tracing wrapper?"""
+    tail = _tail_name(node)
+    if tail in _TRACING_WRAPPERS:
+        return True
+    # functools.partial(jax.jit, ...) / partial(to_static, ...)
+    if isinstance(node, ast.Call) and _tail_name(node.func) == "partial":
+        return bool(node.args) and _is_tracing_expr(node.args[0])
+    return False
+
+
+def _walk_shallow(node: ast.AST, *, into_lambdas: bool = True):
+    """Walk without descending into nested function/class definitions."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            # decorators/defaults evaluate in the enclosing scope
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(n.decorator_list)
+                stack.extend(d for d in n.args.defaults)
+                stack.extend(d for d in n.args.kw_defaults if d is not None)
+            continue
+        if isinstance(n, ast.Lambda) and not into_lambdas:
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+# ----------------------------------------------------------- module analysis
+
+
+class _FuncInfo:
+    __slots__ = ("node", "qualname", "cls", "parent")
+
+    def __init__(self, node, qualname, cls, parent):
+        self.node = node
+        self.qualname = qualname
+        self.cls = cls            # enclosing class name or None
+        self.parent = parent      # enclosing _FuncInfo or None
+
+
+class _ModuleAnalyzer:
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.import_alias: Dict[str, str] = {}   # local name -> dotted module
+        self.from_imports: Dict[str, str] = {}   # local name -> dotted target
+        self.local_aliases: Set[str] = set()     # names from relative imports
+        self.funcs: List[_FuncInfo] = []
+        self.by_name: Dict[str, List[_FuncInfo]] = {}
+        self.by_method: Dict[Tuple[str, str], List[_FuncInfo]] = {}
+        self.node_info: Dict[ast.AST, _FuncInfo] = {}
+        self.traced: Set[ast.AST] = set()
+        self.static_entries: Set[str] = set()    # names of to_static entry points
+        self.violations: List[Violation] = []
+
+    # -- collection ----------------------------------------------------------
+
+    def run(self) -> List[Violation]:
+        self._collect_imports()
+        self._collect_functions(self.tree, cls=None, parent=None, prefix="")
+        self._find_traced()
+        for fi in self.funcs:
+            if fi.node in self.traced:
+                self._check_traced_function(fi)
+        self._check_module_wide()
+        # one report per (rule, line): overlapping checks (e.g. print of an
+        # f-string) must not double-count
+        unique: Dict[Tuple[str, int], Violation] = {}
+        for v in self.violations:
+            unique.setdefault((v.rule, v.line), v)
+        self.violations = sorted(unique.values(),
+                                 key=lambda v: (v.line, v.col, v.rule))
+        return self._apply_suppressions()
+
+    def _collect_imports(self):
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Import):
+                for a in n.names:
+                    if a.asname:
+                        self.import_alias[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.import_alias[head] = head
+            elif isinstance(n, ast.ImportFrom):
+                if n.module and n.level == 0:
+                    for a in n.names:
+                        self.from_imports[a.asname or a.name] = (
+                            f"{n.module}.{a.name}")
+                else:
+                    # relative import: `from . import random` must NOT
+                    # resolve to the stdlib module of the same name
+                    for a in n.names:
+                        self.local_aliases.add(a.asname or a.name)
+
+    def _collect_functions(self, node, cls, parent, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                fi = _FuncInfo(child, qn, cls, parent)
+                self.funcs.append(fi)
+                self.node_info[child] = fi
+                self.by_name.setdefault(child.name, []).append(fi)
+                if cls is not None:
+                    self.by_method.setdefault((cls, child.name), []).append(fi)
+                self._collect_functions(child, cls=None, parent=fi,
+                                        prefix=qn + ".")
+            elif isinstance(child, ast.ClassDef):
+                self._collect_functions(child, cls=child.name, parent=parent,
+                                        prefix=f"{prefix}{child.name}.")
+            else:
+                self._collect_functions(child, cls=cls, parent=parent,
+                                        prefix=prefix)
+
+    # -- traced-set fixpoint -------------------------------------------------
+
+    def _resolve_call_target(self, call: ast.Call, caller: _FuncInfo):
+        """Candidate _FuncInfos a call might dispatch to (intra-module)."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return self.by_name.get(f.id, [])
+        if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls") and caller.cls):
+            return self.by_method.get((caller.cls, f.attr), [])
+        return []
+
+    def _find_traced(self):
+        roots: Set[ast.AST] = set()
+        for fi in self.funcs:
+            for dec in fi.node.decorator_list:
+                if _is_tracing_expr(dec):
+                    roots.add(fi.node)
+                    if _tail_name(dec) == "to_static" or (
+                            isinstance(dec, ast.Call)
+                            and _tail_name(dec.func) == "to_static"):
+                        self.static_entries.add(fi.node.name)
+        # functions passed by name into tracing wrappers, and
+        # `entry = to_static(f)`-style assignments
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            if not _is_tracing_expr(n.func):
+                continue
+            for arg in list(n.args) + [k.value for k in n.keywords]:
+                if isinstance(arg, ast.Name):
+                    for fi in self.by_name.get(arg.id, []):
+                        roots.add(fi.node)
+                elif isinstance(arg, ast.Lambda):
+                    pass  # lambdas analyzed inline via enclosing function
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+                if _tail_name(n.value.func) == "to_static":
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            self.static_entries.add(t.id)
+
+        traced = set(roots)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self.funcs:
+                if fi.node not in traced:
+                    # lexical nesting under a traced function ⇒ traced
+                    p = fi.parent
+                    while p is not None:
+                        if p.node in traced:
+                            traced.add(fi.node)
+                            changed = True
+                            break
+                        p = p.parent
+                if fi.node not in traced:
+                    continue
+                for n in _walk_shallow(fi.node):
+                    if isinstance(n, ast.Call):
+                        for target in self._resolve_call_target(n, fi):
+                            if target.node not in traced:
+                                traced.add(target.node)
+                                changed = True
+        self.traced = traced
+
+    # -- taint ---------------------------------------------------------------
+
+    def _initial_taint(self, fn) -> Set[str]:
+        a = fn.args
+        tainted: Set[str] = set()
+        pos = list(a.posonlyargs) + list(a.args)
+        # defaults align with the tail of the positional list; a static
+        # literal default marks a config parameter, not a tracer
+        n_def = len(a.defaults)
+        static_tail = {p.arg for p, d in zip(pos[len(pos) - n_def:], a.defaults)
+                       if isinstance(d, ast.Constant)}
+        for p in pos:
+            if p.arg in ("self", "cls") or p.arg in static_tail:
+                continue
+            tainted.add(p.arg)
+        for p, d in zip(a.kwonlyargs, a.kw_defaults):
+            if not isinstance(d, ast.Constant):
+                tainted.add(p.arg)
+        if a.vararg:
+            tainted.add(a.vararg.arg)
+        if a.kwarg:
+            tainted.add(a.kwarg.arg)
+        return tainted
+
+    def _expr_tainted(self, node, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in tainted
+        if isinstance(node, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+            # identity tests (`x is None`) never concretize a tracer
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._expr_tainted(node.value, tainted)
+        if isinstance(node, ast.Call):
+            tail = _tail_name(node.func)
+            if tail in _STATIC_CALLS or tail in _STATIC_METHODS:
+                return False
+            if self._expr_tainted(node.func, tainted):
+                return True
+            return any(self._expr_tainted(x, tainted)
+                       for x in list(node.args)
+                       + [k.value for k in node.keywords])
+        if isinstance(node, ast.Lambda):
+            return False
+        if isinstance(node, (ast.Constant, ast.Global, ast.Nonlocal)):
+            return False
+        return any(self._expr_tainted(c, tainted)
+                   for c in ast.iter_child_nodes(node))
+
+    def _propagate_taint(self, fn, tainted: Set[str]):
+        for _ in range(3):
+            changed = False
+
+            def mark(t):
+                nonlocal changed
+                for name in _target_names(t):
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+
+            for n in _walk_shallow(fn):
+                if isinstance(n, ast.Assign):
+                    if self._expr_tainted(n.value, tainted):
+                        for t in n.targets:
+                            mark(t)
+                elif isinstance(n, ast.AnnAssign) and n.value is not None:
+                    if self._expr_tainted(n.value, tainted):
+                        mark(n.target)
+                elif isinstance(n, ast.AugAssign):
+                    if self._expr_tainted(n.value, tainted):
+                        mark(n.target)
+                elif isinstance(n, ast.NamedExpr):
+                    if self._expr_tainted(n.value, tainted):
+                        mark(n.target)
+                elif isinstance(n, (ast.For, ast.AsyncFor, ast.comprehension)):
+                    if self._expr_tainted(n.iter, tainted):
+                        mark_iteration_target(n.iter, n.target, mark)
+                elif isinstance(n, ast.withitem):
+                    if n.optional_vars is not None and self._expr_tainted(
+                            n.context_expr, tainted):
+                        mark(n.optional_vars)
+            if not changed:
+                break
+
+    # -- per-rule checks -----------------------------------------------------
+
+    def _add(self, rule: R.Rule, node: ast.AST, detail: str = ""):
+        msg = rule.name + (f": {detail}" if detail else "")
+        self.violations.append(Violation(
+            rule.id, self.path, getattr(node, "lineno", 1),
+            getattr(node, "col_offset", 0), msg,
+        ))
+
+    def _local_names(self, fn) -> Set[str]:
+        names: Set[str] = set()
+        a = fn.args
+        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            names.add(p.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        for n in _walk_shallow(fn):
+            if isinstance(n, ast.arg):
+                names.add(n.arg)  # lambda parameters
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    names.update(_target_names(t))
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign, ast.NamedExpr)):
+                names.update(_target_names(n.target))
+            elif isinstance(n, (ast.For, ast.AsyncFor)):
+                names.update(_target_names(n.target))
+            elif isinstance(n, ast.comprehension):
+                names.update(_target_names(n.target))
+            elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+                names.update(_target_names(n.optional_vars))
+            elif isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.add(n.name)
+            elif isinstance(n, ast.Import):
+                for al in n.names:
+                    names.add(al.asname or al.name.split(".")[0])
+            elif isinstance(n, ast.ImportFrom):
+                for al in n.names:
+                    names.add(al.asname or al.name)
+        return names
+
+    def _random_target(self, call: ast.Call) -> Optional[str]:
+        """Resolve a call to numpy.random.* / stdlib random.*, else None."""
+        dotted = _dotted(call.func)
+        if dotted:
+            head, _, rest = dotted.partition(".")
+            if head in self.local_aliases:
+                return None
+            base = self.import_alias.get(head) or self.from_imports.get(head)
+            if not base:
+                return None  # unresolvable receiver — don't guess
+            full = base + ("." + rest if rest else "")
+            if full.startswith("numpy.random.") or full.startswith("random."):
+                return full
+            return None
+        if isinstance(call.func, ast.Name):
+            full = self.from_imports.get(call.func.id)
+            if full and (full.startswith("numpy.random.")
+                         or full.startswith("random.")):
+                return full
+        return None
+
+    def _check_traced_function(self, fi: _FuncInfo):
+        fn = fi.node
+        tainted = self._initial_taint(fn)
+        self._propagate_taint(fn, tainted)
+        local = self._local_names(fn)
+
+        # names declared global/nonlocal inside this function
+        escaping: Set[str] = set()
+        # f-strings inside `raise`/assert messages are exempt from TPL302:
+        # the trace is aborting, formatting the culprit is the point
+        in_raise: Set[int] = set()
+        for n in _walk_shallow(fn):
+            if isinstance(n, (ast.Global, ast.Nonlocal)):
+                escaping.update(n.names)
+            elif isinstance(n, ast.Raise):
+                for sub in ast.walk(n):
+                    in_raise.add(id(sub))
+            elif isinstance(n, ast.Assert) and n.msg is not None:
+                for sub in ast.walk(n.msg):
+                    in_raise.add(id(sub))
+
+        for n in _walk_shallow(fn):
+            if isinstance(n, ast.Call):
+                tail = _tail_name(n.func)
+                # TPL101 — host-sync methods
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in ("numpy", "item", "tolist")
+                        and not n.args and not n.keywords):
+                    self._add(R.TRACED_HOST_SYNC, n,
+                              f".{n.func.attr}() in traced function "
+                              f"{fi.qualname!r}")
+                # TPL102 — host casts on tensor-derived values
+                elif (isinstance(n.func, ast.Name)
+                        and n.func.id in ("float", "int", "bool")
+                        and len(n.args) == 1 and not n.keywords
+                        and self._expr_tainted(n.args[0], tainted)):
+                    self._add(R.TRACED_HOST_CAST, n,
+                              f"{n.func.id}() on tensor-derived value in "
+                              f"traced function {fi.qualname!r}")
+                # TPL201 — impure RNG
+                rnd = self._random_target(n)
+                if rnd is not None:
+                    self._add(R.IMPURE_RANDOM, n,
+                              f"{rnd} in traced function {fi.qualname!r}")
+                # TPL302 — printing tracers
+                if (isinstance(n.func, ast.Name)
+                        and n.func.id in ("print", "str", "repr")
+                        and id(n) not in in_raise
+                        and any(self._expr_tainted(a, tainted)
+                                for a in n.args)):
+                    self._add(R.TENSOR_FORMAT, n,
+                              f"{n.func.id}() of tensor-derived value in "
+                              f"traced function {fi.qualname!r}")
+                # TPL402 — mutating non-local containers. A chain through
+                # `.at` (x.at[i].add(v)) is jax's FUNCTIONAL update — skip.
+                if (isinstance(n.func, ast.Attribute)
+                        and n.func.attr in _MUTATOR_METHODS
+                        and not _chain_has_at(n.func.value)):
+                    base = n.func.value
+                    while isinstance(base, (ast.Attribute, ast.Subscript)):
+                        base = base.value
+                    if (isinstance(base, ast.Name)
+                            and base.id not in ("self", "cls")
+                            and base.id not in local):
+                        self._add(R.CLOSURE_MUTATION, n,
+                                  f"{base.id}.{n.func.attr}(...) mutates "
+                                  f"closed-over/global state in traced "
+                                  f"function {fi.qualname!r}")
+            elif isinstance(n, (ast.If, ast.While)):
+                if self._expr_tainted(n.test, tainted):
+                    kind = "if" if isinstance(n, ast.If) else "while"
+                    self._add(R.TENSOR_BRANCH, n.test,
+                              f"python `{kind}` on tensor-derived value in "
+                              f"traced function {fi.qualname!r}")
+            elif isinstance(n, ast.IfExp):
+                if self._expr_tainted(n.test, tainted):
+                    self._add(R.TENSOR_BRANCH, n.test,
+                              f"conditional expression on tensor-derived "
+                              f"value in traced function {fi.qualname!r}")
+            elif isinstance(n, ast.Assert):
+                if self._expr_tainted(n.test, tainted):
+                    self._add(R.TENSOR_BRANCH, n,
+                              f"assert on tensor-derived value in traced "
+                              f"function {fi.qualname!r}")
+            elif isinstance(n, ast.FormattedValue):
+                if id(n) not in in_raise and self._expr_tainted(
+                        n.value, tainted):
+                    self._add(R.TENSOR_FORMAT, n,
+                              f"f-string formats tensor-derived value in "
+                              f"traced function {fi.qualname!r}")
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                targets = n.targets if isinstance(n, ast.Assign) else [n.target]
+                for t in targets:
+                    # TPL401 — writes through global/nonlocal
+                    for name in _target_names(t):
+                        if name in escaping:
+                            self._add(R.GLOBAL_WRITE, n,
+                                      f"write to global/nonlocal {name!r} in "
+                                      f"traced function {fi.qualname!r}")
+                    # TPL402 — subscript store into non-local container
+                    if isinstance(t, ast.Subscript):
+                        base = t.value
+                        while isinstance(base, (ast.Attribute, ast.Subscript)):
+                            base = base.value
+                        if (isinstance(base, ast.Name)
+                                and base.id not in ("self", "cls")
+                                and base.id not in local):
+                            self._add(R.CLOSURE_MUTATION, n,
+                                      f"subscript store into closed-over/"
+                                      f"global {base.id!r} in traced "
+                                      f"function {fi.qualname!r}")
+
+    def _check_module_wide(self):
+        # TPL303 — unhashable static kwargs at to_static entry call sites
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                    and n.func.id in self.static_entries:
+                for kw in n.keywords:
+                    if kw.arg is not None and isinstance(
+                            kw.value, _MUTABLE_LITERALS):
+                        self._add(R.UNHASHABLE_STATIC_ARG, kw.value,
+                                  f"literal {type(kw.value).__name__.lower()} "
+                                  f"as static kwarg {kw.arg!r} to compiled "
+                                  f"entry {n.func.id!r}")
+            # TPL501 — bare except
+            if isinstance(n, ast.ExceptHandler) and n.type is None:
+                self._add(R.BARE_EXCEPT, n, "bare `except:`")
+            # TPL502 — mutable defaults
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                a = n.args
+                for d in list(a.defaults) + [x for x in a.kw_defaults if x]:
+                    if isinstance(d, _MUTABLE_LITERALS) or (
+                            isinstance(d, ast.Call)
+                            and isinstance(d.func, ast.Name)
+                            and d.func.id in ("list", "dict", "set")
+                            and not d.args and not d.keywords):
+                        name = getattr(n, "name", "<lambda>")
+                        self._add(R.MUTABLE_DEFAULT, d,
+                                  f"mutable default argument in {name!r}")
+        # TPL503 — shadowing np/jnp/jax/lax when the module imports them
+        imported_cores = {a for a in _CORE_ALIASES
+                          if a in self.import_alias or a in self.from_imports}
+        if imported_cores:
+            for n in ast.walk(self.tree):
+                shadowed: Iterable[str] = ()
+                if isinstance(n, ast.Assign):
+                    shadowed = [x for t in n.targets
+                                for x in _target_names(t)]
+                elif isinstance(n, (ast.For, ast.AsyncFor)):
+                    shadowed = _target_names(n.target)
+                elif isinstance(n, ast.arg):
+                    shadowed = [n.arg]
+                elif isinstance(n, ast.comprehension):
+                    shadowed = _target_names(n.target)
+                for name in shadowed:
+                    if name in imported_cores:
+                        self._add(R.SHADOWED_IMPORT, n,
+                                  f"{name!r} rebound, shadowing the "
+                                  f"core import")
+
+    # -- suppression ---------------------------------------------------------
+
+    _SUPPRESS_RE = re.compile(
+        r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+?)"
+        r"(?:\s*(?:--+|—)\s*(?P<reason>.*))?\s*$")
+
+    def _suppressions_for_line(self, line_no: int):
+        """Codes suppressed at 1-based line ``line_no``: a disable comment on
+        the line itself, or anywhere in the contiguous block of pure-comment
+        lines directly above it (multi-line justifications are encouraged).
+        Returns (codes, reason)."""
+        candidates = []
+        if 1 <= line_no <= len(self.lines):
+            candidates.append(self.lines[line_no - 1])
+        ln = line_no - 1
+        while ln >= 1 and self.lines[ln - 1].lstrip().startswith("#"):
+            candidates.append(self.lines[ln - 1])
+            ln -= 1
+        for text in candidates:
+            m = self._SUPPRESS_RE.search(text)
+            if m:
+                codes = {c.strip().upper() for c in m.group(1).split(",")
+                         if c.strip()}
+                return codes, (m.group("reason") or "").strip()
+        return set(), ""
+
+    def _apply_suppressions(self) -> List[Violation]:
+        for v in self.violations:
+            codes, reason = self._suppressions_for_line(v.line)
+            if v.rule in codes or "ALL" in codes:
+                v.suppressed = True
+                v.suppress_reason = reason
+        return self.violations
+
+
+# ----------------------------------------------------------------- helpers
+
+
+def _chain_has_at(node: ast.AST) -> bool:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        if isinstance(node, ast.Attribute) and node.attr == "at":
+            return True
+        node = node.value
+    return False
+
+
+def mark_iteration_target(iter_expr: ast.AST, target: ast.AST, mark):
+    """Taint loop/comprehension targets from a tainted iterable — except
+    dict KEYS: under jit, pytree dict keys are static strings, so iterating
+    ``state.items()`` taints only the values and ``.keys()`` taints nothing."""
+    attr = None
+    if isinstance(iter_expr, ast.Call) and isinstance(
+            iter_expr.func, ast.Attribute) and not iter_expr.args:
+        attr = iter_expr.func.attr
+    if attr == "keys":
+        return
+    if attr == "items" and isinstance(target, ast.Tuple) \
+            and len(target.elts) == 2:
+        mark(target.elts[1])
+        return
+    mark(target)
+
+
+def _target_names(t: ast.AST) -> List[str]:
+    if isinstance(t, ast.Name):
+        return [t.id]
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in t.elts:
+            out.extend(_target_names(e))
+        return out
+    if isinstance(t, ast.Starred):
+        return _target_names(t.value)
+    return []
+
+
+# ----------------------------------------------------------------- public API
+
+
+def lint_source(source: str, path: str = "<string>") -> List[Violation]:
+    """Lint one source string. Returns ALL violations, including suppressed
+    ones (check ``.suppressed``)."""
+    try:
+        analyzer = _ModuleAnalyzer(path, source)
+    except SyntaxError as e:
+        return [Violation("TPL000", path, e.lineno or 1, e.offset or 0,
+                          f"syntax-error: {e.msg}")]
+    return analyzer.run()
+
+
+def lint_file(path: str) -> List[Violation]:
+    with open(path, "r", encoding="utf-8") as f:
+        return lint_source(f.read(), path)
+
+
+def _iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_paths(paths: Sequence[str]) -> LintResult:
+    """Lint files/directories. Violations are split into live vs suppressed."""
+    result = LintResult()
+    for path in _iter_py_files(paths):
+        result.files_scanned += 1
+        for v in lint_file(path):
+            (result.suppressed if v.suppressed else result.violations).append(v)
+    return result

@@ -1,0 +1,116 @@
+"""tpulint rule registry.
+
+Every rule has a stable ID (``TPLxxx``), a family, and a one-line
+description. IDs are load-bearing: suppression comments
+(``# tpulint: disable=TPL101``), test fixtures, and the README all key on
+them, so never renumber — retire an ID and mint a new one instead.
+
+Families (first digit of the numeric part):
+
+* ``1xx`` — host-sync: operations that force a device→host transfer under
+  trace and either crash (ConcretizationTypeError) or silently serialize
+  the pipeline.
+* ``2xx`` — impure randomness: Python/NumPy RNG inside traced code bakes
+  one sample into the compiled program forever.
+* ``3xx`` — recompile hazards: patterns that either crash the trace
+  (branching on tracers) or force a recompile per distinct value
+  (unhashable/changing static arguments).
+* ``4xx`` — side effects: writes that escape the functional trace and
+  leak tracers into module/closure state.
+* ``5xx`` — hygiene: framework-agnostic correctness smells we do not want
+  anywhere in a TPU codebase.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    family: str
+    name: str
+    description: str
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def _rule(id: str, family: str, name: str, description: str) -> Rule:
+    r = Rule(id, family, name, description)
+    RULES[id] = r
+    return r
+
+
+TRACED_HOST_SYNC = _rule(
+    "TPL101", "host-sync", "traced-host-sync-call",
+    ".numpy()/.item()/.tolist() inside traced code forces a device->host "
+    "sync; under jit it raises ConcretizationTypeError, in eager it stalls "
+    "the async dispatch queue. Return the value out of the compiled region "
+    "instead.")
+
+TRACED_HOST_CAST = _rule(
+    "TPL102", "host-sync", "traced-host-cast",
+    "float()/int()/bool() on a tensor-derived value inside traced code "
+    "concretizes a tracer. Keep the value on-device (jnp.*) or hoist the "
+    "cast out of the traced function.")
+
+IMPURE_RANDOM = _rule(
+    "TPL201", "impure-random", "impure-randomness",
+    "np.random.*/random.* inside traced code is evaluated ONCE at trace "
+    "time and baked into the program as a constant. Use "
+    "paddle_tpu.framework.random keyed RNG (op_key/key_context) or thread "
+    "a jax.random key explicitly.")
+
+TENSOR_BRANCH = _rule(
+    "TPL301", "recompile", "tensor-dependent-branch",
+    "Python if/while/assert on a tensor-derived value inside traced code "
+    "crashes the trace (TracerBoolConversionError). Use jnp.where / "
+    "lax.cond / lax.while_loop, or make the condition static.")
+
+TENSOR_FORMAT = _rule(
+    "TPL302", "recompile", "tensor-format",
+    "print/f-string/str() of a tensor-derived value inside traced code "
+    "runs at trace time (prints a tracer, or host-syncs through "
+    "Tensor.__repr__). Use jax.debug.print, or log outside the compiled "
+    "region.")
+
+UNHASHABLE_STATIC_ARG = _rule(
+    "TPL303", "recompile", "unhashable-static-arg",
+    "list/dict/set literal passed as a static (non-tensor) keyword to a "
+    "to_static/jit entry point: static arguments key the compile cache and "
+    "must be hashable; a fresh literal per call is at best a recompile per "
+    "call, at worst a TypeError. Pass a tuple or hoist it to a constant.")
+
+GLOBAL_WRITE = _rule(
+    "TPL401", "side-effect", "traced-global-write",
+    "global/nonlocal write inside traced code escapes the functional "
+    "trace: it runs only at trace time and can leak tracers into "
+    "module/closure state. Thread state through arguments and returns.")
+
+CLOSURE_MUTATION = _rule(
+    "TPL402", "side-effect", "traced-closure-mutation",
+    "mutating a closed-over/global container (.append/[k]=v/...) inside "
+    "traced code leaks tracers out of the trace and is invisible to "
+    "recompiles. Return the value instead, or use jax-side state.")
+
+BARE_EXCEPT = _rule(
+    "TPL501", "hygiene", "bare-except",
+    "bare `except:` swallows KeyboardInterrupt/SystemExit and masks real "
+    "trace errors. Catch Exception (or narrower).")
+
+MUTABLE_DEFAULT = _rule(
+    "TPL502", "hygiene", "mutable-default-argument",
+    "mutable default argument ([]/{}/set()) is shared across calls; with "
+    "compile caches keyed on arguments this aliases state across traces. "
+    "Default to None and materialize inside.")
+
+SHADOWED_IMPORT = _rule(
+    "TPL503", "hygiene", "shadowed-core-import",
+    "rebinding np/jnp/jax/lax shadows the framework-critical import; "
+    "downstream code in the same scope silently calls into the wrong "
+    "namespace. Rename the local.")
+
+
+FAMILIES = sorted({r.family for r in RULES.values()})

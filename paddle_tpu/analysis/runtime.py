@@ -1,0 +1,59 @@
+"""Runtime companion to tpulint: tracer-leak guard for the compiled path.
+
+Static analysis catches what it can see; ``leak_guard`` catches the rest at
+runtime by arming ``jax.check_tracer_leaks`` around a compiled-path entry.
+A leaked tracer (a traced value stashed into module/closure state — the
+runtime shadow of TPL401/TPL402) then raises at trace end instead of
+detonating later as an inscrutable ``UnexpectedTracerError`` far from the
+leak site.
+
+Opt-in, because leak checking disables some tracing fast paths: set
+``PADDLE_TPU_CHECK_TRACERS=1`` in the environment (or
+``paddle.set_flags({"FLAGS_check_tracers": True})``) — CI and tests do; the
+production hot path keeps it off.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["leak_guard", "tracer_checks_enabled", "TracerLeakError"]
+
+
+class TracerLeakError(RuntimeError):
+    """A traced value escaped its trace (see tpulint TPL401/TPL402)."""
+
+
+def tracer_checks_enabled() -> bool:
+    from ..framework import flags
+
+    return bool(flags.get_flags("FLAGS_check_tracers")["FLAGS_check_tracers"])
+
+
+@contextlib.contextmanager
+def leak_guard(enabled: bool = None):
+    """Hard-fail on tracers leaking out of the wrapped compiled region.
+
+    ``enabled=None`` (the default) defers to the ``FLAGS_check_tracers``
+    flag / ``PADDLE_TPU_CHECK_TRACERS`` env var, so production callers can
+    wrap their jit entry points unconditionally and pay nothing unless the
+    check is armed.
+    """
+    if enabled is None:
+        enabled = tracer_checks_enabled()
+    if not enabled:
+        yield
+        return
+    import jax
+
+    with jax.check_tracer_leaks():
+        try:
+            yield
+        except Exception as e:
+            if "leak" in str(e).lower() or "Tracer" in type(e).__name__:
+                raise TracerLeakError(
+                    "a traced value leaked out of the compiled region "
+                    "(stored into a global/closure/container during trace). "
+                    "Return the value from the traced function instead — "
+                    "see tpulint rules TPL401/TPL402. Original error: "
+                    f"{e}") from e
+            raise

@@ -20,6 +20,7 @@ from .dtype import (
 from .tensor import (
     Parameter,
     Tensor,
+    TracedTensorError,
     apply_op,
     enable_grad,
     is_grad_enabled,
@@ -43,6 +44,7 @@ from .flags import define_flag, get_flags, set_flags
 __all__ = [
     "Tensor",
     "Parameter",
+    "TracedTensorError",
     "apply_op",
     "no_grad",
     "enable_grad",

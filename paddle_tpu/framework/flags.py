@@ -61,3 +61,12 @@ define_flag("FLAGS_decode_attention_kernel", False,
             "use the Pallas decode-attention kernel instead of the XLA "
             "batched-matvec path (measured slower at decode shapes on v5e)")
 define_flag("FLAGS_log_level", "INFO", "python log level")
+define_flag("FLAGS_check_tracers",
+            os.environ.get("PADDLE_TPU_CHECK_TRACERS", "").lower()
+            in ("1", "true", "yes"),
+            "arm jax.check_tracer_leaks around compiled-path entries "
+            "(paddle_tpu.analysis.leak_guard) so a tracer leaked into "
+            "global/closure state hard-fails at the trace instead of "
+            "detonating later; also settable via env "
+            "PADDLE_TPU_CHECK_TRACERS=1. Off by default: leak checking "
+            "disables tracing fast paths")

@@ -33,6 +33,7 @@ from . import dtype as dtypes
 __all__ = [
     "Tensor",
     "Parameter",
+    "TracedTensorError",
     "apply_op",
     "no_grad",
     "enable_grad",
@@ -43,6 +44,26 @@ __all__ = [
 ]
 
 _tls = threading.local()
+
+
+class TracedTensorError(TypeError):
+    """A host-sync op was called on a Tensor holding a jax tracer.
+
+    Subclasses TypeError so code catching jax's ConcretizationTypeError
+    family (also TypeErrors) keeps working — but the message names the
+    offending Tensor op and how to fix it, instead of surfacing jax's raw
+    tracer dump."""
+
+
+def _raise_if_traced(t: "Tensor", op: str, hint: str):
+    if isinstance(t._data, jax.core.Tracer):
+        raise TracedTensorError(
+            f"Tensor.{op} called on a TRACED value (shape={t.shape}, "
+            f"dtype={dtypes.dtype_name(t.dtype)}) — inside jit/to_static-"
+            f"compiled code this forces a device->host sync, which cannot "
+            f"be traced. {hint} (tpulint: rules TPL101/TPL102/TPL301 catch "
+            f"this statically — run `make lint`.)"
+        )
 
 
 def _grad_enabled() -> bool:
@@ -529,12 +550,24 @@ class Tensor:
             yield self[i]
 
     def __float__(self):
+        _raise_if_traced(
+            self, "__float__ (float(tensor))",
+            "Keep the value on-device (jnp ops) or return it from the "
+            "compiled function and cast outside.")
         return float(self.item())
 
     def __int__(self):
+        _raise_if_traced(
+            self, "__int__ (int(tensor))",
+            "Keep the value on-device (jnp ops) or return it from the "
+            "compiled function and cast outside.")
         return int(self.item())
 
     def __bool__(self):
+        _raise_if_traced(
+            self, "__bool__ (`if tensor:` / bool(tensor))",
+            "Branch with jnp.where / lax.cond, or make the condition a "
+            "static python value.")
         return bool(self.numpy().item())
 
     def __repr__(self):

@@ -270,15 +270,22 @@ class StaticFunction:
             (k, v) for k, v in kwargs.items() if not is_dynamic(v)
         ))
         jitted = self._get_jitted(static_kw)
-        if self._is_layer:
-            layer = self._target
-            out, new_bufs = jitted(state_arrays(layer), xs, dyn_kw)
-            named = dict(layer.named_buffers())
-            for name, arr in new_bufs.items():
-                if name in named and named[name] is not None:
-                    named[name]._data = arr
-        else:
-            out = jitted(xs, dyn_kw)
+        # leak_guard is a no-op unless FLAGS_check_tracers /
+        # PADDLE_TPU_CHECK_TRACERS arms it — then a tracer stashed into
+        # global/closure state during this trace raises here, at the
+        # entry point, instead of as a later UnexpectedTracerError
+        from ..analysis.runtime import leak_guard
+
+        with leak_guard():
+            if self._is_layer:
+                layer = self._target
+                out, new_bufs = jitted(state_arrays(layer), xs, dyn_kw)
+                named = dict(layer.named_buffers())
+                for name, arr in new_bufs.items():
+                    if name in named and named[name] is not None:
+                        named[name]._data = arr
+            else:
+                out = jitted(xs, dyn_kw)
         return jax.tree_util.tree_map(Tensor._wrap, out)
 
     # parity helpers

@@ -155,6 +155,8 @@ def _flash_chunk(q, k, v, q_pos, kv_pos, causal, scale):
     attend must be the flash kernel, not materialized jnp logits)."""
     from ....ops.pallas.flash_attention import flash_attention_with_lse
 
+    # tpulint: disable=TPL301 -- `causal` is a static python bool selecting
+    # the kernel variant at trace time, never a traced value
     if causal:
         out, lse = flash_attention_with_lse(
             q, k, v, scale=scale, q_positions=q_pos, kv_positions=kv_pos

@@ -237,6 +237,8 @@ def _fwd_blk(seq, dtype):
     # overhead beats the wasted half-tiles at these sizes.
     if jnp.dtype(dtype).itemsize > 2:
         return _BLK
+    # tpulint: disable=TPL301 -- `seq` is a static python int (grid sizing
+    # at pallas_call build time), not a traced value
     return 1024 if seq % 1024 == 0 else _BLK
 
 
@@ -396,7 +398,10 @@ def _row_blk(seq, dtype):
     row regime is blk=512 throughout and ends where its unroll gets too
     big to compile."""
     if jnp.dtype(dtype).itemsize > 2:
+        # tpulint: disable=TPL301 -- `seq` is a static python int (row-regime
+        # tile sizing at pallas_call build time), not a traced value
         return _BLK if seq <= 2048 else None
+    # tpulint: disable=TPL301 -- same static `seq` as above
     return _BLK if seq <= 4096 else None  # S=8192: per-pair grid
 
 

@@ -368,11 +368,16 @@ def _paged_slab_kernel(len_ref, bt_ref, q_ref, kp_ref, vp_ref, sc_ref,
     # scratch persists across grid steps: zero the dead tail while the live
     # DMAs fly (stale NaN patterns would poison the PV dot via 0*NaN)
     def ztail(j, _):
+        # tpulint: disable=TPL402 -- kwin/vwin/scwin are Pallas VMEM scratch
+        # Refs: in-place Ref stores ARE the kernel-side memory model, the
+        # closure is over memory handles, not traced values
         kwin[pl.ds(j, 1)] = jnp.zeros((1, page_size, kwin.shape[-1]),
                                       kwin.dtype)
+        # tpulint: disable=TPL402 -- same scratch-Ref store as above
         vwin[pl.ds(j, 1)] = jnp.zeros((1, page_size, vwin.shape[-1]),
                                       vwin.dtype)
         if quantized:
+            # tpulint: disable=TPL402 -- same scratch-Ref store as above
             scwin[pl.ds(j, 1)] = jnp.zeros((1, page_size, 128), scwin.dtype)
         return _
 

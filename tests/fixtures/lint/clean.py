@@ -1,0 +1,42 @@
+"""tpulint fixture: a fully clean compiled-path module — zero violations.
+
+Exercises every idiom the linter must NOT flag: static metadata access,
+dict-key iteration, identity tests, functional .at updates, keyed RNG,
+static-default parameters, raise-path formatting.
+"""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def train_step(state, x, y, key):
+    if not isinstance(state, dict):
+        raise TypeError(f"state must be a dict, got {type(state)}")
+    # dict KEYS are static pytree structure under jit
+    decayed = {k: (v * 0.99 if k.endswith("w") else v)
+               for k, v in state.items()}
+    names = [k for k in state.keys()]
+    noise = jax.random.normal(key, x.shape)
+    h = x + noise
+    for _, v in decayed.items():
+        h = h + jnp.mean(v)
+    return h, names
+
+
+@jax.jit
+def masked_update(buf, idx, val):
+    return buf.at[idx].add(val)
+
+
+def shape_logic(x, axis=0, keepdim=False):
+    # static-default params are config, not tracers
+    if axis == 0 and not keepdim:
+        return jnp.sum(x, axis=axis)
+    return jnp.sum(x, axis=axis, keepdims=keepdim)
+
+
+@jax.jit
+def optional_input(x, y=None):
+    if y is None:
+        return x
+    return x + y

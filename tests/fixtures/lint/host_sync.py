@@ -1,0 +1,46 @@
+"""tpulint fixture: host-sync family (TPL101/TPL102). NOT meant to run."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_syncs(x, y):
+    a = x.numpy()  # EXPECT: TPL101
+    b = x.item()  # EXPECT: TPL101
+    c = y.tolist()  # EXPECT: TPL101
+    return a, b, c
+
+
+@jax.jit
+def bad_casts(x):
+    f = float(jnp.sum(x))  # EXPECT: TPL102
+    i = int(x)  # EXPECT: TPL102
+    g = bool(x.mean())  # EXPECT: TPL102
+    return f, i, g
+
+
+def reached_from_trace(t):
+    return t.item()  # EXPECT: TPL101
+
+
+@jax.jit
+def entry(t):
+    return reached_from_trace(t)
+
+
+@jax.jit
+def suppressed_sync(x):
+    v = x.item()  # tpulint: disable=TPL101 -- fixture: demonstrates suppression (EXPECT-SUPPRESSED: TPL101)
+    return v
+
+
+def eager_is_fine(x):
+    # not traced: host syncs are legal (if slow) in eager code
+    return x.numpy(), float(x.sum())
+
+
+@jax.jit
+def static_metadata_is_fine(x):
+    # shape/dtype/len are static under trace — no violations here
+    n = len(x.shape)
+    return jnp.reshape(x, (x.shape[0], -1)) if n > 1 else x

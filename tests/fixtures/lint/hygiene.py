@@ -1,0 +1,44 @@
+"""tpulint fixture: hygiene family (TPL501/502/503). NOT meant to run."""
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+
+def bad_bare_except(x):
+    try:
+        return x.numpy()
+    except:  # EXPECT: TPL501
+        return None
+
+
+def bad_mutable_default(x, history=[]):  # EXPECT: TPL502
+    history.append(x)
+    return history
+
+
+def bad_mutable_default_call(x, cache=dict()):  # EXPECT: TPL502
+    return cache
+
+
+def bad_shadowing(values):
+    for np in values:  # EXPECT: TPL503
+        pass
+    jnp = values  # EXPECT: TPL503
+    return jnp
+
+
+def narrow_except_is_fine(x):
+    try:
+        return np.asarray(x)
+    except (TypeError, ValueError):
+        return None
+
+
+def none_default_is_fine(x, history=None):
+    history = history if history is not None else []
+    history.append(x)
+    return history
+
+
+def suppressed_default(x, order=[]):  # tpulint: disable=TPL502 -- fixture: module-lifetime accumulator (EXPECT-SUPPRESSED: TPL502)
+    return order

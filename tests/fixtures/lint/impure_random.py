@@ -1,0 +1,37 @@
+"""tpulint fixture: impure-randomness family (TPL201). NOT meant to run."""
+import random
+from random import randint
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def bad_numpy_rng(x):
+    noise = np.random.standard_normal(x.shape)  # EXPECT: TPL201
+    return x + noise
+
+
+@jax.jit
+def bad_stdlib_rng(x):
+    r = random.random()  # EXPECT: TPL201
+    k = randint(0, 10)  # EXPECT: TPL201
+    return x * r + k
+
+
+@jax.jit
+def keyed_rng_is_fine(x, key):
+    # threading an explicit jax.random key is THE sanctioned pattern
+    return x + jax.random.normal(key, x.shape)
+
+
+def eager_rng_is_fine():
+    # data pipeline / init code runs on host — numpy RNG is legal there
+    return np.random.default_rng(0).standard_normal((4, 4))
+
+
+@jax.jit
+def suppressed_rng(x):
+    jitter = np.random.rand()  # tpulint: disable=TPL201 -- fixture: trace-time constant intended (EXPECT-SUPPRESSED: TPL201)
+    return x + jitter

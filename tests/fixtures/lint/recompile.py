@@ -1,0 +1,54 @@
+"""tpulint fixture: recompile-hazard family (TPL301/302/303). NOT meant to run."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.jit import to_static
+
+
+@jax.jit
+def bad_branching(x, y):
+    if x > 0:  # EXPECT: TPL301
+        y = y + 1
+    while y.sum() < 10:  # EXPECT: TPL301
+        y = y * 2
+    assert x.mean() > 0  # EXPECT: TPL301
+    z = 1 if x else 0  # EXPECT: TPL301
+    return y + z
+
+
+@jax.jit
+def bad_formatting(x):
+    print("x is", x)  # EXPECT: TPL302
+    msg = f"mean={x.mean()}"  # EXPECT: TPL302
+    return x, msg
+
+
+@to_static
+def compiled_entry(x, mode="train", dims=None):
+    return x
+
+
+def bad_static_args(t):
+    return compiled_entry(t, dims=[1, 2, 3])  # EXPECT: TPL303
+
+
+@jax.jit
+def identity_tests_are_fine(x, y):
+    # `is None` never concretizes a tracer
+    if y is None:
+        return x
+    return x + y
+
+
+@jax.jit
+def raise_formatting_is_fine(x):
+    if x is None:
+        raise ValueError(f"bad input {x!r}")  # trace is aborting: exempt
+    return x
+
+
+@jax.jit
+def suppressed_branch(x):
+    if x > 0:  # tpulint: disable=TPL301 -- fixture: suppressed on purpose (EXPECT-SUPPRESSED: TPL301)
+        return x
+    return -x

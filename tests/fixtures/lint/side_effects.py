@@ -1,0 +1,48 @@
+"""tpulint fixture: side-effect family (TPL401/TPL402). NOT meant to run."""
+import jax
+import jax.numpy as jnp
+
+_STEP_COUNT = 0
+_ACTIVATION_CACHE = {}
+_TRACE_LOG = []
+
+
+@jax.jit
+def bad_global_write(x):
+    global _STEP_COUNT
+    _STEP_COUNT = _STEP_COUNT + 1  # EXPECT: TPL401
+    return x
+
+
+def make_counter():
+    count = 0
+
+    @jax.jit
+    def bad_nonlocal_write(x):
+        nonlocal count
+        count = count + 1  # EXPECT: TPL401
+        return x
+
+    return bad_nonlocal_write
+
+
+@jax.jit
+def bad_container_mutation(x):
+    _TRACE_LOG.append(x)  # EXPECT: TPL402
+    _ACTIVATION_CACHE["last"] = x  # EXPECT: TPL402
+    return x
+
+
+@jax.jit
+def functional_updates_are_fine(x, buf):
+    # .at[...].set/add is jax's FUNCTIONAL update — not a mutation
+    buf = buf.at[0].set(x.sum())
+    local = []
+    local.append(x)  # mutating a trace-local container is fine
+    return buf, local
+
+
+@jax.jit
+def suppressed_mutation(x):
+    _TRACE_LOG.append(x)  # tpulint: disable=TPL402 -- fixture: deliberate leak demo (EXPECT-SUPPRESSED: TPL402)
+    return x

@@ -10,7 +10,16 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Each case boots a CLEAN-env python (no JAX_PLATFORMS pin): on a hosted-TPU
+# box the plugin claims the chip at interpreter start and can block for
+# minutes, and the 8-virtual-device dryrun itself compiles a full multichip
+# program. Up to 600 s per case does not fit the tier-1 (-m 'not slow')
+# budget — these run in the driver-facing/on-chip lane instead.
+pytestmark = pytest.mark.slow
 
 
 def _clean_env():

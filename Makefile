@@ -1,5 +1,7 @@
 # paddle_tpu test entry points.
 #
+# lint    — tpulint trace-safety static analysis (paddle_tpu/analysis/).
+#           Pure stdlib, no jax import, fast. Gates `test`.
 # test    — the virtual-8-CPU-device suite (mesh/sharding logic, kernel
 #           math in interpret mode). Safe anywhere.
 # onchip  — the real-TPU lane (VERDICT r3 #4): Pallas kernels through
@@ -7,7 +9,10 @@
 #           run ONE at a time (a killed claim wedges the tunnel relay).
 # bench   — the driver-visible headline benchmark (real TPU).
 
-test:
+lint:
+	python tools/lint_tpu.py paddle_tpu examples tools --fail-on-violation
+
+test: lint
 	python -m pytest tests/ -x -q --ignore=tests/onchip
 
 onchip:
@@ -16,4 +21,4 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: test onchip bench
+.PHONY: lint test onchip bench

@@ -28,6 +28,13 @@ def main():
     ap.add_argument("--tiny", action="store_true")
     ap.add_argument("--int8-cache", action="store_true",
                     help="store KV pages int8 with per-row scales")
+    ap.add_argument("--weight-quant", choices=["none", "int8", "int4"],
+                    default="none",
+                    help="weight-only-quantize the Linears before "
+                         "serving; the GEMM backend (fused Pallas "
+                         "dequant-in-kernel on TPU, XLA convert-fusion "
+                         "on CPU) follows FLAGS_weight_only_quant_backend"
+                         " — no engine changes needed")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -41,6 +48,13 @@ def main():
         intermediate_size=512, max_position=512)
     model = LlamaForCausalLM(cfg)
     model.eval()
+    if args.weight_quant != "none":
+        from paddle_tpu.nn.quant import quant_backend, quantize_for_decode
+
+        _, swapped = quantize_for_decode(
+            model, algo=f"weight_only_{args.weight_quant}")
+        print(f"weight-only {args.weight_quant}: {swapped} Linears "
+              f"swapped, GEMM backend={quant_backend()}")
 
     eng = Engine(model, max_slots=4, num_pages=96, page_size=16,
                  chunk_size=8, dtype=jnp.float32,

@@ -7,15 +7,49 @@ cost is NCCL re-init). On TPU the compile IS the restart cost, so the cache
 is wired into the elastic path: ``ElasticSupervisor`` exports
 ``PADDLE_COMPILATION_CACHE_DIR`` to every (re)spawned worker and
 ``init_parallel_env`` picks it up.
+
+Also home to the in-process kernel-choice memo (``memoize_kernel_choice``):
+hand-written Pallas kernels pick launch geometry (block shapes, grid
+layout) per problem shape, and that choice must be pinned for the life of
+the process — a heuristic consulted fresh at every trace could retune a
+warm serving binary and silently recompile every cached program built on
+the old geometry. One level up from the XLA cache: same idea, applied to
+the selection logic instead of the compiled artifact.
 """
 from __future__ import annotations
 
 import os
-from typing import Optional
+import threading
+from typing import Any, Callable, Dict, Hashable, Optional, Tuple
 
 ENV_VAR = "PADDLE_COMPILATION_CACHE_DIR"
 
 _enabled_dir: Optional[str] = None
+
+_KERNEL_CHOICES: Dict[Tuple[Hashable, ...], Any] = {}
+_KERNEL_CHOICES_LOCK = threading.Lock()
+
+
+def memoize_kernel_choice(key: Tuple[Hashable, ...],
+                          compute: Callable[[], Any]) -> Any:
+    """First call per ``key`` runs ``compute()``; every later call returns
+    the pinned value. Keys are namespaced tuples, e.g.
+    ``("wq_matmul_blocks", rows, k, n, dtype)``. Thread-safe (the serving
+    engine traces from worker threads)."""
+    try:
+        return _KERNEL_CHOICES[key]
+    except KeyError:
+        pass
+    with _KERNEL_CHOICES_LOCK:
+        if key not in _KERNEL_CHOICES:
+            _KERNEL_CHOICES[key] = compute()
+        return _KERNEL_CHOICES[key]
+
+
+def clear_kernel_choices() -> None:
+    """Drop pinned kernel choices (tests; a live process should never)."""
+    with _KERNEL_CHOICES_LOCK:
+        _KERNEL_CHOICES.clear()
 
 
 def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:

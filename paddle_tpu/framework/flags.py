@@ -57,6 +57,12 @@ define_flag("FLAGS_use_packed_attention", None,
 define_flag("FLAGS_flash_attn_block_q", 128, "flash attention q tile")
 define_flag("FLAGS_flash_attn_block_k", 128, "flash attention kv tile")
 define_flag("FLAGS_check_nan_inf", False, "enable debug nan checks in optimizer steps")
+define_flag("FLAGS_weight_only_quant_backend", "auto",
+            "weight_only_linear GEMM backend: 'auto' = fused Pallas "
+            "dequant-in-kernel matmul on TPU, plain-XLA dequant dots "
+            "elsewhere (so tier-1 runs under JAX_PLATFORMS=cpu); "
+            "'pallas' forces the fused kernel (interpret mode off-TPU); "
+            "'xla' forces the convert-fusion path everywhere")
 define_flag("FLAGS_decode_attention_kernel", False,
             "use the Pallas decode-attention kernel instead of the XLA "
             "batched-matvec path (measured slower at decode shapes on v5e)")

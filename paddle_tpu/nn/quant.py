@@ -4,21 +4,53 @@ Reference capability: ``paddle.nn.quant.weight_quantize`` /
 ``weight_only_linear`` backing ``fused_multi_transformer_int8_op.cu``
 (SURVEY A3.x) — small-batch decode is weight-bandwidth-bound, so int8
 weights halve the dominant HBM traffic. TPU design: weights are STORED
-int8 with one f32 scale per output channel (symmetric); the matmul runs
-``x @ convert(W_int8)`` — XLA fuses the convert into the dot's operand
-load, so only int8 bytes cross HBM — and the per-channel scale multiplies
-the f32/bf16 output. No custom kernel needed; the bandwidth win is the
-storage dtype.
+int8 with one f32 scale per output channel (symmetric).
+
+Two GEMM backends, selected by ``FLAGS_weight_only_quant_backend``:
+
+* ``pallas`` (default on TPU) — ``ops/pallas/quant_matmul.py``: dequant
+  happens inside the kernel in VMEM; packed int4 unpacks its nibbles
+  in-kernel, ONE pass over the weight bytes, one fused kernel per GEMM.
+* ``xla`` (default elsewhere) — ``x @ convert(W_int8)`` riding XLA
+  convert-fusion; int4 runs as two dots over nibble halves so the shifts
+  stay fusible unary chains.
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
+from ..framework.flags import get_flags
 from ..framework.tensor import Tensor, apply_op
 from .layer import Layer
 
 __all__ = ["weight_quantize", "weight_only_linear", "WeightOnlyLinear",
-           "quantize_for_decode"]
+           "quantize_for_decode", "quant_backend"]
+
+
+def quant_backend(rows=None) -> str:
+    """Resolve the active weight-only GEMM backend ('pallas' | 'xla').
+
+    ``auto`` picks the fused Pallas kernel on TPU and the XLA
+    convert-fusion path elsewhere. ``rows`` (when known) routes
+    prefill-wide batches back to XLA even under ``auto``+TPU: at
+    compute-bound row counts the MXU-saturating XLA dot wins and the
+    fused kernel's bandwidth advantage is moot."""
+    val = get_flags("FLAGS_weight_only_quant_backend")[
+        "FLAGS_weight_only_quant_backend"]
+    if val not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"FLAGS_weight_only_quant_backend: {val!r} not in "
+            "('auto', 'pallas', 'xla')")
+    if val == "auto":
+        from ..ops.pallas.quant_matmul import PALLAS_MAX_ROWS
+
+        if jax.default_backend() != "tpu":
+            return "xla"
+        if rows is not None and rows > PALLAS_MAX_ROWS:
+            return "xla"
+        return "pallas"
+    return val
 
 
 def _t(x):
@@ -60,37 +92,59 @@ def weight_quantize(x, algo="weight_only_int8"):
 def weight_only_linear(x, weight, bias=None, weight_scale=None,
                        weight_dtype="int8"):
     """y = x @ dequant(W) + b with int8- or int4-stored W (reference:
-    paddle.nn.quant.weight_only_linear).
+    paddle.nn.quant.weight_only_linear). Backend per ``quant_backend()``.
 
-    int4 runs as TWO dots — even input columns against the sign-extended
-    low nibbles, odd columns against the high nibbles — so the nibble
-    shifts stay elementwise unary chains XLA fuses into the dot operand
-    loads (an unpack-to-[in,out] would materialize a full-width weight
-    and forfeit the bandwidth win)."""
+    XLA path: int4 runs as TWO dots — even input columns against the
+    sign-extended low nibbles, odd columns against the high nibbles — so
+    the nibble shifts stay elementwise unary chains XLA fuses into the
+    dot operand loads (an unpack-to-[in,out] would materialize a
+    full-width weight and forfeit the bandwidth win). Pallas path: one
+    fused dequant-in-kernel matmul (``ops/pallas/quant_matmul.py``)."""
     if weight_dtype not in ("int8", "int4"):
         raise NotImplementedError("weight_only_linear: int8/int4 only")
     args = [_t(x), _t(weight), _t(weight_scale)]
     has_bias = bias is not None
     if has_bias:
         args.append(_t(bias))
+    # resolved at trace time from static shape + flag: recorded programs
+    # bake the backend in, exactly like the reference's gflags dispatch
+    rows = 1
+    for d in _t(x)._data.shape[:-1]:
+        rows *= int(d)
+    backend = quant_backend(rows=rows)
 
     def fn(xa, wq, sc, *b):
-        if weight_dtype == "int4":
-            lo = jnp.right_shift(jnp.left_shift(wq, 4), 4).astype(xa.dtype)
-            hi = jnp.right_shift(wq, 4).astype(xa.dtype)
-            y = (jnp.dot(xa[..., 0::2], lo,
-                         preferred_element_type=jnp.float32)
-                 + jnp.dot(xa[..., 1::2], hi,
-                           preferred_element_type=jnp.float32))
-        else:
-            y = jnp.dot(xa, wq.astype(xa.dtype),
-                        preferred_element_type=jnp.float32)
-        y = (y * sc.astype(jnp.float32)).astype(xa.dtype)
-        if b:
-            y = y + b[0].astype(xa.dtype)
-        return y
+        bias_a = b[0] if b else None
+        if backend == "pallas":
+            from ..ops.pallas.quant_matmul import quant_matmul
+
+            return quant_matmul(xa, wq, sc, bias=bias_a,
+                                weight_dtype=weight_dtype)
+        return quant_matmul_xla(xa, wq, sc, bias=bias_a,
+                                weight_dtype=weight_dtype)
 
     return apply_op(fn, *args)
+
+
+def quant_matmul_xla(xa, wq, sc, bias=None, weight_dtype="int8"):
+    """Raw-array XLA backend: int8 rides convert-fusion into the dot's
+    operand load; int4 runs as two dots over the nibble halves so the
+    shifts stay fusible unary chains (an unpack-to-[in,out] would
+    materialize a full-width weight and forfeit the bandwidth win)."""
+    if weight_dtype == "int4":
+        lo = jnp.right_shift(jnp.left_shift(wq, 4), 4).astype(xa.dtype)
+        hi = jnp.right_shift(wq, 4).astype(xa.dtype)
+        y = (jnp.dot(xa[..., 0::2], lo,
+                     preferred_element_type=jnp.float32)
+             + jnp.dot(xa[..., 1::2], hi,
+                       preferred_element_type=jnp.float32))
+    else:
+        y = jnp.dot(xa, wq.astype(xa.dtype),
+                    preferred_element_type=jnp.float32)
+    y = (y * sc.astype(jnp.float32)).astype(xa.dtype)
+    if bias is not None:
+        y = y + bias.astype(xa.dtype)
+    return y
 
 
 class WeightOnlyLinear(Layer):

@@ -19,3 +19,9 @@ from .paged_attention import (
     paged_decode_attention_ref,
     quantize_rows_int8,
 )
+from .quant_matmul import (
+    quant_matmul,
+    quant_matmul_pallas,
+    quant_matmul_ref,
+    unpack_int4,
+)

@@ -1,0 +1,242 @@
+"""Fused weight-only quant matmul Pallas kernels for the decode path.
+
+TPU-native rewrite of the ``fused_multi_transformer_int8_op.cu``-class
+weight-only GEMMs (SURVEY A3.x). The plain-XLA path in ``nn/quant.py``
+leans on convert-fusion for int8 and runs packed int4 as TWO dots over
+unpacked nibble halves — BENCH_r05 shows that makes int4 decode *slower*
+than int8 (0.71 vs 0.533 ms/token) despite moving half the HBM bytes.
+Here the dequant happens inside the kernel in VMEM:
+
+* int8  — weight block [bk, bn] loads once as int8, casts to the
+  activation dtype on the VPU, one MXU dot per (n, k) grid step.
+* int4  — the PACKED byte block [bk//2, bn] loads once; low/high nibbles
+  sign-extend in VMEM (int32 shift pair) and contract against the
+  even/odd activation columns. One pass over the weight bytes, two MXU
+  dots per block, ONE kernel for the whole GEMM.
+
+f32 accumulation lives in VMEM scratch across the k grid dimension; the
+per-output-channel scale (and optional bias) apply in the epilogue at the
+last k step. Decode rows are padded to a sublane tile; K/N pad up to the
+selected block shape, so non-multiple shapes are handled (the pad is a
+no-op for real model dims, which are multiples of 128).
+
+Block shapes are picked per (rows, in, out, dtype) and memoized through
+``framework.compile_cache.memoize_kernel_choice`` so a warm server never
+retunes mid-flight. On non-TPU backends the kernel runs in Pallas
+interpret mode (exact, slow) — CI covers it; dispatch policy lives in
+``nn/quant.py``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ...framework.compile_cache import memoize_kernel_choice
+
+__all__ = ["quant_matmul", "quant_matmul_pallas", "quant_matmul_ref",
+           "unpack_int4", "select_block_shapes"]
+
+_ROW_TILE = 8  # pad decode rows to one f32 sublane tile
+# prefill-sized row counts are compute-bound: route them back to XLA
+# (nn/quant.py consults this) — the fused kernel targets skinny decode
+PALLAS_MAX_ROWS = 256
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# --------------------------------------------------------------- unpack
+
+
+def unpack_int4(packed):
+    """[K//2, N] packed nibbles → [K, N] int8 (row 2k = low nibble of
+    byte k, row 2k+1 = high nibble; the ``weight_quantize`` layout)."""
+    w = jnp.asarray(packed).astype(jnp.int32)
+    lo = jnp.right_shift(jnp.left_shift(w, 28), 28)
+    hi = jnp.right_shift(w, 4)
+    k2, n = w.shape
+    return jnp.stack([lo, hi], axis=1).reshape(2 * k2, n).astype(jnp.int8)
+
+
+# ------------------------------------------------------- block selection
+
+
+def select_block_shapes(rows, k, n, weight_dtype):
+    """(bk, bn) for the fused kernel, memoized per problem shape.
+
+    bn: widest of {512, 256, 128} lanes that the (padded) output is not
+    dominated by — wide n blocks amortize the scale/bias epilogue and the
+    revisit of the f32 accumulator. bk: deep K stripes keep the MXU fed
+    between epilogues while the [bk, bn] int8 block (bk//2 bytes for
+    int4) stays small next to the ~16 MB VMEM budget; shallow K problems
+    collapse to one k step.
+    """
+    def compute():
+        bn = 128
+        for cand in (512, 256):
+            if n >= cand:
+                bn = cand
+                break
+        bk = 128
+        for cand in (1024, 512, 256):
+            if k >= cand:
+                bk = cand
+                break
+        return bk, bn
+
+    return memoize_kernel_choice(
+        ("wq_matmul_blocks", rows, k, n, weight_dtype), compute)
+
+
+# --------------------------------------------------------------- kernels
+
+
+def _epilogue(k_step, grid_k, acc_ref, s_ref, b_ref, o_ref):
+    @pl.when(k_step == grid_k - 1)
+    def _():
+        y = acc_ref[:] * s_ref[:].astype(jnp.float32)  # [rows,bn]*[1,bn]
+        if b_ref is not None:
+            y = y + b_ref[:].astype(jnp.float32)
+        o_ref[:] = y.astype(o_ref.dtype)
+
+
+def _int8_kernel(x_ref, w_ref, s_ref, *rest, grid_k):
+    b_ref, o_ref, acc_ref = rest if len(rest) == 3 else (None,) + rest
+    k_step = pl.program_id(1)
+
+    @pl.when(k_step == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jnp.dot(x_ref[:], w_ref[:].astype(x_ref.dtype),
+                          preferred_element_type=jnp.float32)
+    _epilogue(k_step, grid_k, acc_ref, s_ref, b_ref, o_ref)
+
+
+def _int4_kernel(xe_ref, xo_ref, w_ref, s_ref, *rest, grid_k):
+    b_ref, o_ref, acc_ref = rest if len(rest) == 3 else (None,) + rest
+    k_step = pl.program_id(1)
+
+    @pl.when(k_step == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # one load of the packed bytes; both nibbles dequant in VMEM
+    w = w_ref[:].astype(jnp.int32)  # [bk//2, bn]
+    lo = jnp.right_shift(jnp.left_shift(w, 28), 28).astype(xe_ref.dtype)
+    hi = jnp.right_shift(w, 4).astype(xe_ref.dtype)
+    acc_ref[:] += (
+        jnp.dot(xe_ref[:], lo, preferred_element_type=jnp.float32)
+        + jnp.dot(xo_ref[:], hi, preferred_element_type=jnp.float32))
+    _epilogue(k_step, grid_k, acc_ref, s_ref, b_ref, o_ref)
+
+
+# --------------------------------------------------------------- wrapper
+
+
+def quant_matmul_pallas(x, wq, scales, bias=None, weight_dtype="int8",
+                        block_shapes=None, interpret=None):
+    """y = x @ dequant(wq) * scales + bias as ONE fused Pallas kernel.
+
+    x [..., K] (f32/bf16) · wq int8 [K, N] or packed int4 [K//2, N] ·
+    scales f32 [N] · bias [N] optional → [..., N] in x.dtype.
+    """
+    x = jnp.asarray(x)
+    wq = jnp.asarray(wq)
+    scales = jnp.asarray(scales)
+    if weight_dtype not in ("int8", "int4"):
+        raise NotImplementedError(f"quant_matmul: {weight_dtype!r}")
+    k = x.shape[-1]
+    if weight_dtype == "int4":
+        if k % 2:
+            raise ValueError(f"int4 needs even K (got {k})")
+        if wq.shape[0] * 2 != k:
+            raise ValueError(
+                f"packed int4 weight rows {wq.shape[0]} != K/2 = {k // 2}")
+    elif wq.shape[0] != k:
+        raise ValueError(f"weight rows {wq.shape[0]} != K = {k}")
+    n = wq.shape[1]
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, k)
+    rows = x2.shape[0]
+    if interpret is None:
+        interpret = _interpret()
+
+    bk, bn = block_shapes or select_block_shapes(rows, k, n, weight_dtype)
+    rows_p = _round_up(max(rows, 1), _ROW_TILE)
+    kp = _round_up(k, bk)
+    np_ = _round_up(n, bn)
+    grid = (np_ // bn, kp // bk)
+
+    x2 = jnp.pad(x2, ((0, rows_p - rows), (0, kp - k)))
+    sc = jnp.pad(scales.astype(jnp.float32), (0, np_ - n)).reshape(1, np_)
+    operands, in_specs = [], []
+    if weight_dtype == "int4":
+        wp = jnp.pad(wq, ((0, (kp - k) // 2), (0, np_ - n)))
+        # even/odd activation columns split OUTSIDE the kernel — a cheap
+        # relayout of the tiny decode activation, never of the weight
+        operands += [x2[:, 0::2], x2[:, 1::2], wp, sc]
+        in_specs += [
+            pl.BlockSpec((rows_p, bk // 2), lambda j, kk: (0, kk)),
+            pl.BlockSpec((rows_p, bk // 2), lambda j, kk: (0, kk)),
+            pl.BlockSpec((bk // 2, bn), lambda j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda j, kk: (0, j)),
+        ]
+        kernel = _int4_kernel
+    else:
+        wp = jnp.pad(wq, ((0, kp - k), (0, np_ - n)))
+        operands += [x2, wp, sc]
+        in_specs += [
+            pl.BlockSpec((rows_p, bk), lambda j, kk: (0, kk)),
+            pl.BlockSpec((bk, bn), lambda j, kk: (kk, j)),
+            pl.BlockSpec((1, bn), lambda j, kk: (0, j)),
+        ]
+        kernel = _int8_kernel
+    if bias is not None:
+        b = jnp.pad(jnp.asarray(bias).astype(jnp.float32),
+                    (0, np_ - n)).reshape(1, np_)
+        operands.append(b)
+        in_specs.append(pl.BlockSpec((1, bn), lambda j, kk: (0, j)))
+
+    out = pl.pallas_call(
+        functools.partial(kernel, grid_k=grid[1]),
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((rows_p, bn), lambda j, kk: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((rows_p, np_), x.dtype),
+        scratch_shapes=[pltpu.VMEM((rows_p, bn), jnp.float32)],
+        compiler_params=pltpu.TPUCompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
+    return out[:rows, :n].reshape(*lead, n)
+
+
+def quant_matmul_ref(x, wq, scales, bias=None, weight_dtype="int8"):
+    """Plain-XLA dequant-dot reference (the parity oracle: independent of
+    every Pallas code path, same dtype discipline as the fused kernel —
+    weight cast to x.dtype, f32 accumulate, scale/bias in f32)."""
+    x = jnp.asarray(x)
+    w = unpack_int4(wq) if weight_dtype == "int4" else jnp.asarray(wq)
+    y = jnp.dot(x, w.astype(x.dtype), preferred_element_type=jnp.float32)
+    y = y * jnp.asarray(scales).astype(jnp.float32)
+    if bias is not None:
+        y = y + jnp.asarray(bias).astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+def quant_matmul(x, wq, scales, bias=None, weight_dtype="int8"):
+    """Fused kernel on TPU, interpret-mode kernel elsewhere. Most callers
+    want ``nn.quant.weight_only_linear`` (flag-dispatched, Tensor-aware);
+    this is the raw-array entry point."""
+    return quant_matmul_pallas(x, wq, scales, bias=bias,
+                               weight_dtype=weight_dtype)

@@ -210,3 +210,37 @@ class TestPagedOnChip:
         want = _paged_slab_ref(q, kq, vq, bt, lengths, 1 / 8.0,
                                scale_pages=sc)
         assert _err(got, want) < 5e-2
+
+
+class TestQuantMatmulOnChip:
+    """Mosaic-lowered fused weight-only matmul vs the plain-XLA
+    dequant-dot reference (a nibble-shift or epilogue lowering bug must
+    surface here, not as a wrong decode bench number)."""
+
+    @pytest.mark.parametrize("weight_dtype", ["int8", "int4"])
+    @pytest.mark.parametrize("rows,k,n", [(8, 768, 3072), (1, 3072, 768),
+                                          (8, 768, 2500)])
+    def test_fused_matches_reference(self, rng, weight_dtype, rows, k, n):
+        from paddle_tpu.ops.pallas.quant_matmul import (
+            _interpret, quant_matmul_pallas, quant_matmul_ref)
+
+        assert not _interpret()
+        x = jnp.asarray(rng.standard_normal((rows, k)) * 0.3,
+                        jnp.bfloat16)
+        lim = 7 if weight_dtype == "int4" else 127
+        q = rng.integers(-lim, lim + 1, (k, n)).astype(np.int8)
+        if weight_dtype == "int4":
+            q = np.bitwise_or(
+                np.bitwise_and(q[0::2], np.int8(0x0F)),
+                np.left_shift(q[1::2], 4).astype(np.int8)).astype(np.int8)
+        sc = ((rng.random(n) + 0.1) / lim).astype(np.float32)
+        b = rng.standard_normal(n).astype(np.float32)
+        got = quant_matmul_pallas(x, q, sc, b, weight_dtype)
+        want = quant_matmul_ref(x, q, sc, b, weight_dtype)
+        # identical f32 accumulate both sides; daylight is the bf16 round
+        assert _err(got, want) < 5e-2
+
+    def test_weight_only_linear_routes_pallas_on_tpu(self, rng):
+        from paddle_tpu.nn.quant import quant_backend
+
+        assert quant_backend(rows=8) == "pallas"  # auto on TPU

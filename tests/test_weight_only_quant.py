@@ -73,6 +73,56 @@ class TestWeightQuant:
                 algo="weight_only_int4")
 
 
+class TestInt4PackingRoundTrip:
+    """Property tests for the nibble packing itself: pack→unpack is the
+    identity on the clipped/rounded int4 code, for every nibble pair and
+    across random shapes/scales (the fused kernel and the XLA two-dot
+    path both decode this exact layout — a packing bug breaks both)."""
+
+    def test_all_nibble_pairs_roundtrip_exact(self):
+        from paddle_tpu.ops.pallas.quant_matmul import unpack_int4
+
+        vals = np.arange(-7, 8, dtype=np.int8)
+        lo, hi = np.meshgrid(vals, vals, indexing="ij")
+        q = np.stack([lo.reshape(-1), hi.reshape(-1)])  # [2, 225]
+        packed = np.bitwise_or(
+            np.bitwise_and(q[0::2], np.int8(0x0F)),
+            np.left_shift(q[1::2], 4).astype(np.int8)).astype(np.int8)
+        assert packed.shape == (1, 225)
+        assert np.array_equal(np.asarray(unpack_int4(packed)), q)
+
+    @pytest.mark.parametrize("shape", [(2, 3), (64, 96), (130, 8),
+                                       (256, 130)])
+    def test_pack_unpack_equals_clipped_reference(self, rng, shape):
+        from paddle_tpu.ops.pallas.quant_matmul import unpack_int4
+
+        w = (rng.standard_normal(shape) * 0.4).astype(np.float32)
+        # a few saturating outliers so the clip actually engages
+        w[0, 0] = 9.0
+        w[-1, -1] = -9.0
+        qw, sc = weight_quantize(paddle.to_tensor(w),
+                                 algo="weight_only_int4")
+        assert np.asarray(qw).shape == (shape[0] // 2, shape[1])
+        # clipped reference code, same f32 arithmetic as weight_quantize
+        # (bit-identical rounding) but independent of the packing
+        q_ref = np.asarray(jnp.clip(
+            jnp.round(jnp.asarray(w) / jnp.asarray(sc._data)[None, :]),
+            -7, 7).astype(jnp.int8))
+        unpacked = np.asarray(unpack_int4(np.asarray(qw)))
+        assert unpacked.dtype == np.int8
+        assert np.array_equal(unpacked, q_ref)
+        assert unpacked.min() >= -7 and unpacked.max() <= 7
+
+    def test_weight_only_linear_odd_K_raises_on_pallas(self):
+        from paddle_tpu.ops.pallas.quant_matmul import quant_matmul_pallas
+
+        with pytest.raises(ValueError, match="even K"):
+            quant_matmul_pallas(np.ones((1, 7), np.float32),
+                                np.ones((3, 4), np.int8),
+                                np.ones(4, np.float32),
+                                weight_dtype="int4", interpret=True)
+
+
 class TestQuantizedModel:
     def test_quantize_for_decode_swaps_and_generates(self, rng):
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM

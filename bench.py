@@ -321,6 +321,28 @@ def main():
     decode = bench_decode(decode_cfg, on_tpu)
     paged = bench_paged_decode(decode_cfg, on_tpu)
 
+    # observability snapshot (ISSUE 3): the perf trajectory carries the
+    # telemetry the run produced — how many programs compiled, whether
+    # anything retraced mid-bench (a retrace here is a perf bug), and the
+    # serving engine's decode-latency distribution as measured by its own
+    # TPOT histogram rather than the bench's external timers.
+    from paddle_tpu.observability import histogram_summary, metric_total
+
+    tpot = histogram_summary("paddle_serving_tpot_seconds")
+    metrics_block = {
+        "compile_count": int(
+            metric_total("paddle_jit_compiles_total")
+            + metric_total("paddle_serving_compiled_programs_total")),
+        "retrace_count": int(metric_total("paddle_jit_retraces_total")),
+        "preemptions": int(metric_total("paddle_serving_preemptions_total")),
+        "decode_latency_ms": {
+            "count": int(tpot.get("count", 0)),
+            "mean": round(1e3 * tpot.get("mean", 0.0), 3),
+            "p50": round(1e3 * tpot.get("p50", 0.0), 3),
+            "p99": round(1e3 * tpot.get("p99", 0.0), 3),
+        },
+    }
+
     out = {
         "metric": "gpt_medium_355m_train_mfu_1chip",
         "value": round(float(r_med["mfu"]), 4),
@@ -344,6 +366,7 @@ def main():
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         **decode,
         **paged,
+        "metrics": metrics_block,
     }
     print(json.dumps(out))
 

@@ -35,12 +35,35 @@ def main():
                          "dequant-in-kernel on TPU, XLA convert-fusion "
                          "on CPU) follows FLAGS_weight_only_quant_backend"
                          " — no engine changes needed")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="serve Prometheus text exposition on this port "
+                         "(/metrics); 0 picks an ephemeral port, printed "
+                         "at startup")
+    ap.add_argument("--metrics-linger", type=float, default=0.0,
+                    help="keep the /metrics endpoint up this many "
+                         "seconds after serving completes (scrape tests; "
+                         "a real deployment's process simply stays up)")
+    ap.add_argument("--metrics-jsonl", default=None,
+                    help="append one JSONL metrics snapshot here after "
+                         "the run")
     args = ap.parse_args()
 
     import jax.numpy as jnp
 
     from paddle_tpu.inference.engine import Engine
     from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+    server = None
+    if args.metrics_port is not None:
+        from paddle_tpu.framework.compile_cache import ensure_compile_metrics
+        from paddle_tpu.observability import start_metrics_server
+
+        ensure_compile_metrics()  # full catalogue visible from scrape #1
+        server = start_metrics_server(args.metrics_port)
+        # the scrape contract: TTFT/TPOT histograms, page-pool gauges,
+        # preemption/retrace counters — see README "Observability"
+        print(f"metrics: http://localhost:{server.port}/metrics",
+              flush=True)
 
     paddle.seed(0)
     cfg = tiny_llama_config() if args.tiny else tiny_llama_config(
@@ -86,6 +109,21 @@ def main():
               f"{len(r.tokens)} tokens (streamed {len(streams[i])})")
     print(f"pool fully recycled: {len(eng._free_pages)}/{free0} free "
           f"(int8_cache={args.int8_cache})")
+
+    if args.metrics_jsonl:
+        from paddle_tpu.observability import write_jsonl_snapshot
+
+        write_jsonl_snapshot(args.metrics_jsonl,
+                             extra={"source": "serve_llama_paged"})
+        print(f"metrics snapshot appended to {args.metrics_jsonl}")
+    if server is not None:
+        if args.metrics_linger > 0:
+            import time
+
+            print(f"metrics: lingering {args.metrics_linger}s for "
+                  "scrapes", flush=True)
+            time.sleep(args.metrics_linger)
+        server.close()
 
 
 if __name__ == "__main__":

@@ -172,6 +172,9 @@ class _ModuleAnalyzer:
         self.import_alias: Dict[str, str] = {}   # local name -> dotted module
         self.from_imports: Dict[str, str] = {}   # local name -> dotted target
         self.local_aliases: Set[str] = set()     # names from relative imports
+        self.obs_aliases: Set[str] = set()       # names bound to the
+        # observability package (absolute OR relative import) — receivers
+        # of TPL601's "metrics call under trace" check
         self.funcs: List[_FuncInfo] = []
         self.by_name: Dict[str, List[_FuncInfo]] = {}
         self.by_method: Dict[Tuple[str, str], List[_FuncInfo]] = {}
@@ -208,7 +211,20 @@ class _ModuleAnalyzer:
                     else:
                         head = a.name.split(".")[0]
                         self.import_alias[head] = head
+                    if "observability" in a.name:
+                        self.obs_aliases.add(
+                            a.asname or a.name.split(".")[0])
             elif isinstance(n, ast.ImportFrom):
+                # observability bindings resolve the same way for
+                # absolute (paddle_tpu.observability) and relative
+                # (..observability) imports
+                if n.module and "observability" in n.module:
+                    self.obs_aliases.update(a.asname or a.name
+                                            for a in n.names)
+                else:
+                    for a in n.names:
+                        if a.name == "observability":
+                            self.obs_aliases.add(a.asname or a.name)
                 if n.module and n.level == 0:
                     for a in n.names:
                         self.from_imports[a.asname or a.name] = (
@@ -498,6 +514,15 @@ class _ModuleAnalyzer:
                 if rnd is not None:
                     self._add(R.IMPURE_RANDOM, n,
                               f"{rnd} in traced function {fi.qualname!r}")
+                # TPL601 — metrics recorded under trace: any call whose
+                # receiver chain roots at an observability import
+                # (obs.counter(...), counter(...).inc(), reg.gauge(...))
+                root = _call_chain_root(n.func)
+                if root in self.obs_aliases:
+                    shown = _dotted(n.func) or root
+                    self._add(R.OBSERVABILITY_IN_TRACE, n,
+                              f"{shown}(...) in traced function "
+                              f"{fi.qualname!r}")
                 # TPL302 — printing tracers
                 if (isinstance(n.func, ast.Name)
                         and n.func.id in ("print", "str", "repr")
@@ -650,6 +675,16 @@ class _ModuleAnalyzer:
 
 
 # ----------------------------------------------------------------- helpers
+
+
+def _call_chain_root(node: ast.AST) -> Optional[str]:
+    """Root Name of an attribute/call chain (``a.b(x).c`` → 'a'), walking
+    through intermediate calls/subscripts; None for non-Name roots."""
+    while isinstance(node, (ast.Attribute, ast.Subscript, ast.Call)):
+        node = node.func if isinstance(node, ast.Call) else node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
 
 
 def _chain_has_at(node: ast.AST) -> bool:

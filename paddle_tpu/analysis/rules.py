@@ -19,6 +19,9 @@ Families (first digit of the numeric part):
   leak tracers into module/closure state.
 * ``5xx`` — hygiene: framework-agnostic correctness smells we do not want
   anywhere in a TPU codebase.
+* ``6xx`` — observability: telemetry recorded from the wrong side of the
+  trace boundary (metrics must be host-side; under trace they run once
+  at trace time or capture tracers).
 """
 from __future__ import annotations
 
@@ -111,6 +114,14 @@ SHADOWED_IMPORT = _rule(
     "rebinding np/jnp/jax/lax shadows the framework-critical import; "
     "downstream code in the same scope silently calls into the wrong "
     "namespace. Rename the local.")
+
+OBSERVABILITY_IN_TRACE = _rule(
+    "TPL601", "observability", "metrics-call-in-trace",
+    "paddle_tpu.observability API call inside traced code: the recording "
+    "runs ONCE at trace time (a counter that never moves again), and a "
+    "tensor-derived sample is a tracer the metric cannot hold. Record on "
+    "the host, outside the compiled region — return the value out of the "
+    "trace if it is tensor-derived.")
 
 
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -29,20 +29,91 @@ _enabled_dir: Optional[str] = None
 _KERNEL_CHOICES: Dict[Tuple[Hashable, ...], Any] = {}
 _KERNEL_CHOICES_LOCK = threading.Lock()
 
+# ------------------------------------------------- compile-path telemetry
+# "Why is my server recompiling" must be answerable from metrics alone
+# (ISSUE 3): the jit entry (StaticFunction) reports every program-cache
+# hit, every compile's wall time, and attributes each RETRACE to the
+# shape/dtype signature that triggered it. The metric objects are built
+# lazily so importing compile_cache never pulls in the observability
+# package (and the first record costs one dict build, the rest a lookup).
+
+_JIT_METRICS: Optional[Dict[str, Any]] = None
+
+
+def _jit_metrics() -> Dict[str, Any]:
+    global _JIT_METRICS
+    if _JIT_METRICS is None:
+        from ..observability import counter, histogram
+
+        _JIT_METRICS = {
+            "compiles": counter(
+                "paddle_jit_compiles_total",
+                "programs traced+compiled at a jit entry point"),
+            "compile_seconds": histogram(
+                "paddle_jit_compile_seconds",
+                "wall time of the first call per program signature "
+                "(trace + XLA compile + first dispatch)"),
+            "hits": counter(
+                "paddle_jit_cache_hits_total",
+                "jit-entry calls served by an already-compiled program"),
+            "retraces": counter(
+                "paddle_jit_retraces_total",
+                "compiles AFTER an entry's first program, attributed to "
+                "the triggering shape/dtype signature",
+                labelnames=("fn", "signature")),
+            "kernel_hits": counter(
+                "paddle_kernel_choice_hits_total",
+                "kernel-geometry memo hits, by namespace",
+                labelnames=("kind",)),
+            "kernel_misses": counter(
+                "paddle_kernel_choice_misses_total",
+                "kernel-geometry choices computed+pinned, by namespace",
+                labelnames=("kind",)),
+        }
+    return _JIT_METRICS
+
+
+def ensure_compile_metrics() -> None:
+    """Register the compile-path metrics zero-valued so a scrape shows
+    the full catalogue before the first compile happens (a dashboard
+    query against an absent series looks like a broken exporter)."""
+    _jit_metrics()
+
+
+def record_jit_cache_hit() -> None:
+    _jit_metrics()["hits"].inc()
+
+
+def record_jit_compile(fn_name: str, signature: str, seconds: float,
+                       retrace: bool) -> None:
+    m = _jit_metrics()
+    m["compiles"].inc()
+    m["compile_seconds"].observe(seconds)
+    if retrace:
+        m["retraces"].labels(fn=fn_name, signature=signature).inc()
+
 
 def memoize_kernel_choice(key: Tuple[Hashable, ...],
                           compute: Callable[[], Any]) -> Any:
     """First call per ``key`` runs ``compute()``; every later call returns
     the pinned value. Keys are namespaced tuples, e.g.
     ``("wq_matmul_blocks", rows, k, n, dtype)``. Thread-safe (the serving
-    engine traces from worker threads)."""
+    engine traces from worker threads). Hit/miss counters land in the
+    metrics registry (these run on the host at trace time — a miss per
+    execution would mean the pinning is broken)."""
+    kind = str(key[0]) if key else "?"
     try:
-        return _KERNEL_CHOICES[key]
+        value = _KERNEL_CHOICES[key]
+        _jit_metrics()["kernel_hits"].labels(kind=kind).inc()
+        return value
     except KeyError:
         pass
     with _KERNEL_CHOICES_LOCK:
         if key not in _KERNEL_CHOICES:
+            _jit_metrics()["kernel_misses"].labels(kind=kind).inc()
             _KERNEL_CHOICES[key] = compute()
+        else:
+            _jit_metrics()["kernel_hits"].labels(kind=kind).inc()
         return _KERNEL_CHOICES[key]
 
 

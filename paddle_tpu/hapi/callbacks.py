@@ -178,8 +178,14 @@ class VisualDL(Callback):
     falls back to JSONL files with the same API, so dashboards and plain
     tooling both work."""
 
-    def __init__(self, log_dir: str = "./log"):
+    def __init__(self, log_dir: str = "./log", runtime_metrics: bool = False):
         self.log_dir = log_dir
+        # runtime_metrics=True also publishes the paddle_tpu.observability
+        # registry (compile/retrace counters, serving histograms) into the
+        # same log at every epoch end — losses and runtime telemetry side
+        # by side in one TensorBoard run (tag mapping: README
+        # "Observability")
+        self.runtime_metrics = runtime_metrics
         self._writer = None
         self._jsonl = None
         self._global_step = 0
@@ -224,13 +230,37 @@ class VisualDL(Callback):
     def on_epoch_end(self, epoch, logs=None):
         for k, v in (logs or {}).items():
             self._scalar(f"train_epoch/{k}", v, epoch)
+        if self.runtime_metrics:
+            from ..observability import TBEventsBridge
+
+            cb = self
+
+            class _Shim:  # routes through _scalar: works for BOTH the
+                def add_scalar(self, tag, value, step):  # tbevents and
+                    cb._scalar(tag, value, step)         # jsonl backends
+
+            TBEventsBridge(_Shim()).publish(epoch)
 
     def on_eval_end(self, logs=None):
         for k, v in (logs or {}).items():
             self._scalar(f"eval/{k}", v, self._global_step)
 
-    def on_train_end(self, logs=None):
+    def flush(self):
+        """Force buffered events to disk (fit's exception path calls this
+        before re-raising, so a crash cannot eat the last events)."""
+        if self._writer is not None and hasattr(self._writer, "flush"):
+            self._writer.flush()
+        if self._jsonl is not None:
+            self._jsonl.flush()
+            os.fsync(self._jsonl.fileno())
+
+    def close(self):
         if self._writer is not None:
             self._writer.close()
+            self._writer = None
         if self._jsonl is not None:
             self._jsonl.close()
+            self._jsonl = None
+
+    def on_train_end(self, logs=None):
+        self.close()

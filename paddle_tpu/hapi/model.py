@@ -124,29 +124,45 @@ class Model:
 
         cbs.on_train_begin()
         history = {"loss": []}
-        for epoch in range(epochs):
-            cbs.on_epoch_begin(epoch)
-            epoch_losses = []
-            for step, batch in enumerate(loader):
-                cbs.on_train_batch_begin(step)
-                ins, labels = self._split_batch(batch)
-                losses = self.train_batch(ins, labels)
-                epoch_losses.append(losses[0])
-                cbs.on_train_batch_end(step, {"loss": losses[0]})
+        try:
+            for epoch in range(epochs):
+                cbs.on_epoch_begin(epoch)
+                epoch_losses = []
+                for step, batch in enumerate(loader):
+                    cbs.on_train_batch_begin(step)
+                    ins, labels = self._split_batch(batch)
+                    losses = self.train_batch(ins, labels)
+                    epoch_losses.append(losses[0])
+                    cbs.on_train_batch_end(step, {"loss": losses[0]})
+                    if self.stop_training:
+                        break
+                logs = {"loss": float(np.mean(epoch_losses))
+                        if epoch_losses else 0.0}
+                history["loss"].append(logs["loss"])
+                if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                    eval_logs = self.evaluate(eval_loader, callbacks=cbs,
+                                              _in_fit=True)
+                    logs.update(eval_logs)
+                cbs.on_epoch_end(epoch, logs)
+                if save_dir and (epoch % save_freq == 0):
+                    self.save(os.path.join(save_dir, str(epoch)))
                 if self.stop_training:
                     break
-            logs = {"loss": float(np.mean(epoch_losses))
-                    if epoch_losses else 0.0}
-            history["loss"].append(logs["loss"])
-            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
-                eval_logs = self.evaluate(eval_loader, callbacks=cbs,
-                                          _in_fit=True)
-                logs.update(eval_logs)
-            cbs.on_epoch_end(epoch, logs)
-            if save_dir and (epoch % save_freq == 0):
-                self.save(os.path.join(save_dir, str(epoch)))
-            if self.stop_training:
-                break
+        except BaseException:
+            # training died mid-epoch (OOM, KeyboardInterrupt, a traced
+            # error): the scalar writers' buffered events must still hit
+            # disk — flush+close every callback that can, then re-raise.
+            # on_train_end is NOT fanned out here: checkpoint-on-end etc.
+            # must not run on a half-trained model.
+            for c in cbs.callbacks:
+                for meth in ("flush", "close"):
+                    fn = getattr(c, meth, None)
+                    if callable(fn):
+                        try:
+                            fn()
+                        except Exception:
+                            pass  # best-effort: never mask the real error
+            raise
         cbs.on_train_end()
         if save_dir:
             self.save(os.path.join(save_dir, "final"))

@@ -15,6 +15,7 @@ InterpreterCore/CINN to escape.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -194,6 +195,24 @@ def functional_call(
 # ------------------------------------------------------------------ to_static
 
 
+def _arg_signature(xs, dyn_kw, static_kw) -> str:
+    """Compact shape/dtype signature of a jit-entry call — the compile-
+    cache key jax effectively uses, rendered human-readable so a retrace
+    metric names its trigger (e.g. ``float32[8,128]|int32[8]``)."""
+    parts = []
+    for leaf in jax.tree_util.tree_leaves((list(xs), dyn_kw)):
+        dt = getattr(leaf, "dtype", None)
+        shp = getattr(leaf, "shape", None)
+        if dt is not None and shp is not None:
+            parts.append(
+                f"{jnp.dtype(dt).name}[{','.join(str(s) for s in shp)}]")
+        else:
+            parts.append(type(leaf).__name__)
+    if static_kw:
+        parts.append(f"static{static_kw!r}")
+    return "|".join(parts)
+
+
 class StaticFunction:
     """Compiled wrapper produced by ``to_static`` (reference:
     python/paddle/jit/dy2static/program_translator.py StaticFunction —
@@ -208,6 +227,11 @@ class StaticFunction:
         )
         self._jit_cache = None
         self._exported = None
+        # program signatures this entry has compiled for — the second and
+        # later entries ARE retraces, attributed by signature in metrics
+        self._seen_sigs = set()
+        self._metric_name = getattr(
+            fn_or_layer, "__name__", type(fn_or_layer).__name__)
 
     @property
     def _layer(self):
@@ -270,6 +294,19 @@ class StaticFunction:
             (k, v) for k, v in kwargs.items() if not is_dynamic(v)
         ))
         jitted = self._get_jitted(static_kw)
+        # compile/retrace telemetry ("why is my server recompiling"
+        # answerable from metrics alone, ISSUE 3): a signature this entry
+        # has not seen means jax is about to trace+compile — time the
+        # call and attribute a retrace to the triggering signature
+        from ..framework import compile_cache as _cc
+
+        sig = _arg_signature(xs, dyn_kw, static_kw)
+        fresh = sig not in self._seen_sigs
+        if fresh:
+            self._seen_sigs.add(sig)
+            t0 = time.perf_counter()
+        else:
+            _cc.record_jit_cache_hit()
         # leak_guard is a no-op unless FLAGS_check_tracers /
         # PADDLE_TPU_CHECK_TRACERS arms it — then a tracer stashed into
         # global/closure state during this trace raises here, at the
@@ -286,6 +323,10 @@ class StaticFunction:
                         named[name]._data = arr
             else:
                 out = jitted(xs, dyn_kw)
+        if fresh:
+            _cc.record_jit_compile(
+                self._metric_name, sig, time.perf_counter() - t0,
+                retrace=len(self._seen_sigs) > 1)
         return jax.tree_util.tree_map(Tensor._wrap, out)
 
     # parity helpers

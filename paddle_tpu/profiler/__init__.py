@@ -122,6 +122,11 @@ class Profiler:
         self._transition()
 
     def stop(self):
+        # the final in-flight step (started by the last step()/start())
+        # used to be dropped — its time belongs in the summary
+        if self._t_last is not None:
+            self._step_times.append(time.perf_counter() - self._t_last)
+            self._t_last = None
         if self._tracing:
             jax.profiler.stop_trace()
             self._tracing = False
@@ -170,10 +175,13 @@ class Profiler:
         import numpy as np
 
         ts = np.asarray(self._step_times) * 1e3
+        steps_per_sec = 1e3 * len(ts) / ts.sum() if ts.sum() > 0 else 0.0
         lines = [
             "---- step time summary ----",
             f"steps: {len(ts)}   mean: {ts.mean():.2f} ms   p50: {np.percentile(ts, 50):.2f} ms"
-            f"   p90: {np.percentile(ts, 90):.2f} ms   max: {ts.max():.2f} ms",
+            f"   p90: {np.percentile(ts, 90):.2f} ms   p99: {np.percentile(ts, 99):.2f} ms"
+            f"   max: {ts.max():.2f} ms",
+            f"steps/sec: {steps_per_sec:.2f}",
         ]
         if self._last_export:
             lines.append(f"trace exported to: {self._last_export}")

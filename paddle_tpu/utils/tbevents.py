@@ -137,6 +137,13 @@ class EventFileWriter:
             self._open()
         self._record(_scalar_event(tag, value, step, time.time()))
 
+    def flush(self):
+        """os-level flush so a crash right after cannot lose events
+        (_record already flushes the python buffer per write)."""
+        if self._f is not None:
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
     def close(self):
         if self._f is not None:
             self._f.close()

@@ -1,0 +1,48 @@
+"""TPL6xx fixtures: telemetry recorded from the wrong side of the trace
+boundary. Metric recording must be HOST-side — under trace it runs once
+at trace time (a counter that never moves again) or captures a tracer."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import observability
+from paddle_tpu.observability import counter, histogram
+
+_STEPS = counter("fixture_steps_total", "host-side is fine")
+
+
+@jax.jit
+def traced_direct(x):
+    counter("fixture_bad_total", "under trace").inc()  # EXPECT: TPL601
+    return x * 2
+
+
+@jax.jit
+def traced_module_attr(x):
+    y = jnp.sum(x)
+    observability.gauge("fixture_bad_gauge", "g").set(1.0)  # EXPECT: TPL601
+    return y
+
+
+@jax.jit
+def traced_histogram(x):
+    h = histogram("fixture_bad_hist")  # EXPECT: TPL601
+    return x + 1
+
+
+@jax.jit
+def traced_suppressed(x):
+    # trace-time counting is the POINT here: this counts compiles, not
+    # executions
+    # tpulint: disable=TPL601 -- fixture: deliberate trace-time count
+    counter("fixture_traces_total", "x").inc()  # EXPECT-SUPPRESSED: TPL601
+    return x - 1
+
+
+def host_side_loop(xs):
+    """Recording between dispatches — the supported pattern."""
+    total = 0.0
+    for x in xs:
+        y = traced_direct(x)
+        _STEPS.inc()
+        total += float(jax.device_get(y).sum())
+    return total

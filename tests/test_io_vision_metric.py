@@ -172,7 +172,13 @@ class TestProfilerFacade:
         for _ in range(3):
             p.step()
         p.stop()
-        assert "steps: 3" in p.summary()
+        # 3 step() boundaries + the final in-flight step recorded by
+        # stop() (it used to be dropped)
+        s = p.summary()
+        assert "steps: 4" in s
+        assert "p99" in s and "steps/sec" in s
+        p.stop()  # idempotent: a second stop must not add a phantom step
+        assert "steps: 4" in p.summary()
 
     def test_mfu_readout(self):
         from paddle_tpu.profiler import mfu

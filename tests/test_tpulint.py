@@ -82,11 +82,11 @@ class TestFixtureExactness:
                 fam = RULES[v.rule].family
                 (by_family_sup if v.suppressed else by_family_live).add(fam)
         families = {r.family for r in RULES.values()}
-        assert len(families) >= 5
+        assert len(families) >= 6
         assert by_family_live == families
         # at least one demonstrated suppression per bucket we ship
         assert {"host-sync", "impure-random", "recompile", "side-effect",
-                "hygiene"} <= by_family_live
+                "hygiene", "observability"} <= by_family_live
 
     def test_suppression_reason_is_captured(self):
         got = lint_file(os.path.join(FIXTURES, "host_sync.py"))
@@ -98,7 +98,7 @@ class TestRegistry:
     def test_rule_ids_are_stable_and_documented(self):
         assert set(RULES) == {
             "TPL101", "TPL102", "TPL201", "TPL301", "TPL302", "TPL303",
-            "TPL401", "TPL402", "TPL501", "TPL502", "TPL503",
+            "TPL401", "TPL402", "TPL501", "TPL502", "TPL503", "TPL601",
         }
         for r in RULES.values():
             assert r.description and r.name and r.family

@@ -1,0 +1,125 @@
+"""Metrics-overhead microbench: steady-state paged decode with telemetry
+on vs off (ISSUE 3 acceptance: <1% throughput delta).
+
+Usage: python tools/mb_metrics.py [TAG]
+
+Drives the SAME steady-state decode window as bench.py's
+``bench_engine_decode`` (full occupancy, warm programs, admission outside
+the timed window) through two engines that differ ONLY in
+``Engine(metrics=...)``, interleaves several timed passes of each, and
+takes the median — single-shot deltas ride dispatch jitter far above the
+effect being measured. One JSON line per mode appended to
+tools/mb_results.jsonl (like mb_flash/mb_quant), plus a combined line
+with ``overhead_frac`` = (off - on) / off throughput.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.framework.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.inference.engine import Engine  # noqa: E402
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM  # noqa: E402
+
+
+def build_engine(model, cfg, on_tpu, metrics):
+    # max_chain pinned to 1: the adaptive chain-depth calibration is
+    # timing-driven, so two engine instances can settle on DIFFERENT
+    # depths — a throughput delta that would swamp the metric-recording
+    # effect this bench isolates. Depth 1 also maximizes scheduling steps
+    # (= metric records) per token, the conservative direction.
+    slots = 8 if on_tpu else 2
+    return Engine(model, max_slots=slots,
+                  num_pages=(slots + 2) * cfg.max_position // 16 + 1,
+                  page_size=16, chunk_size=32 if on_tpu else 4,
+                  max_chain=1, metrics=metrics)
+
+
+def timed_pass(eng, prompts, new_tokens):
+    """One steady-state decode window: admit outside the clock (bench.py
+    r3 protocol), then step to drain. Returns (tokens, seconds)."""
+    reqs = [eng.add_request(p, new_tokens) for p in prompts]
+    eng._admit()
+    done0 = sum(len(r.tokens) for r in reqs)
+    t0 = time.perf_counter()
+    while eng.step():
+        pass
+    dt = time.perf_counter() - t0
+    return sum(len(r.tokens) for r in reqs) - done0, dt
+
+
+def main():
+    tag = sys.argv[1] if len(sys.argv) > 1 else "metrics"
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                        max_position=1024, vocab_size=50304)
+        new_tokens, reps = 256, 5
+    else:
+        # big enough that a pass runs ~0.5 s: per-pass scheduler/GC
+        # jitter amortizes below the 1%% budget being verified
+        cfg = GPTConfig(hidden_size=256, num_layers=4, num_heads=4,
+                        max_position=256, vocab_size=2048)
+        new_tokens, reps = 48, 9
+
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    if on_tpu:
+        model.bfloat16()
+    slots = 8 if on_tpu else 2
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, cfg.vocab_size, (int(rng.integers(24, 120)),))
+               for _ in range(slots)]
+
+    engines = {"on": build_engine(model, cfg, on_tpu, metrics=True),
+               "off": build_engine(model, cfg, on_tpu, metrics=False)}
+    for eng in engines.values():  # compile + calibrate outside the clock
+        for _ in range(2):
+            timed_pass(eng, prompts, new_tokens)
+
+    # The true recording cost is ~4 us/step (measured standalone)
+    # against ms-scale steps — single-pass timings have multi-percent
+    # scheduler/GC jitter far above that, so: interleave the modes
+    # (alternating order, drift hits both), drop each mode's slowest
+    # pass (GC spikes), and compare TOTAL tokens over TOTAL time.
+    samples = {"on": [], "off": []}
+    for i in range(reps):
+        order = ("on", "off") if i % 2 else ("off", "on")
+        for mode in order:
+            samples[mode].append(timed_pass(engines[mode], prompts,
+                                            new_tokens))
+    rate = {}
+    for mode, ss in samples.items():
+        kept = sorted(ss, key=lambda s: s[1])[:-1]  # trim slowest pass
+        rate[mode] = sum(t for t, _ in kept) / sum(d for _, d in kept)
+
+    device = "tpu" if on_tpu else "cpu"
+    lines = []
+    for mode in ("off", "on"):
+        lines.append({"tag": tag, "bench": "metrics_overhead", "mode": mode,
+                      "device": device, "slots": slots,
+                      "new_tokens": new_tokens, "reps": reps,
+                      "tokens_per_sec": round(rate[mode], 1)})
+    overhead = 1.0 - rate["on"] / rate["off"]
+    lines.append({"tag": tag, "bench": "metrics_overhead", "mode": "delta",
+                  "device": device,
+                  "overhead_frac": round(overhead, 4),
+                  "budget_frac": 0.01,
+                  "within_budget": bool(overhead < 0.01)})
+    with open("tools/mb_results.jsonl", "a") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+            print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,10 @@
 #
 # lint    — tpulint trace-safety static analysis (paddle_tpu/analysis/).
 #           Pure stdlib, no jax import, fast. Gates `test`.
+# analyze — tpucheck jaxpr-level analysis (paddle_tpu/analysis/jaxpr/):
+#           peak-memory liveness, collective/mesh consistency, donation,
+#           roofline cost over the real entry points. Traces tiny
+#           configs under JAX_PLATFORMS=cpu; gates `test` like lint.
 # test    — the virtual-8-CPU-device suite (mesh/sharding logic, kernel
 #           math in interpret mode). Safe anywhere.
 # onchip  — the real-TPU lane (VERDICT r3 #4): Pallas kernels through
@@ -12,7 +16,10 @@
 lint:
 	python tools/lint_tpu.py paddle_tpu examples tools --fail-on-violation
 
-test: lint
+analyze:
+	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation
+
+test: lint analyze
 	python -m pytest tests/ -x -q --ignore=tests/onchip
 
 onchip:

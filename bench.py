@@ -22,43 +22,38 @@ import jax.numpy as jnp
 import numpy as np
 
 
-PEAK_BF16_FLOPS = {
-    # per-chip dense bf16 peak
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-}
-
-HBM_BYTES_PER_SEC = {
-    # per-chip HBM bandwidth (datasheet)
-    "TPU v4": 1.2e12,
-    "TPU v5 lite": 819e9,
-    "TPU v5e": 819e9,
-    "TPU v5": 2.77e12,
-    "TPU v5p": 2.77e12,
-    "TPU v6 lite": 1.64e12,
-    "TPU v6e": 1.64e12,
-}
+# device peak tables live with the tpucheck cost model (ISSUE 4: one
+# source of truth for predicted AND measured rooflines)
+from paddle_tpu.analysis.jaxpr.cost import (  # noqa: E402
+    HBM_BYTES_PER_SEC, PEAK_BF16_FLOPS, hbm_bw, peak_flops)
 
 
-def _lookup(table, device, default):
-    kind = getattr(device, "device_kind", "")
-    for key, val in sorted(table.items(), key=lambda kv: -len(kv[0])):
-        if kind.startswith(key):
-            return val
-    return default
+def decode_step_cost(model, batch, total_seq, device):
+    """tpucheck roofline rollup of ONE decode step of ``model`` at this
+    cache geometry: (predicted ms/token on ``device``, rollup). The
+    prediction shares the measured floor's byte conventions (packed
+    quant buffers count packed bytes), so predicted/measured drift is an
+    estimator bug, not a units mismatch — BENCH_r06+ tracks the ratio."""
+    import jax.numpy as jnp
 
+    from paddle_tpu.analysis.jaxpr import rollup_fn
+    from paddle_tpu.framework.tensor import Tensor, pause_tape
+    from paddle_tpu.jit import functional_call, state_arrays
 
-def peak_flops(device) -> float:
-    return _lookup(PEAK_BF16_FLOPS, device, 197e12)
+    caches = [c._data for c in model.init_caches(batch, total_seq)]
+    state = state_arrays(model)
+    tok = jnp.zeros((batch, 1), jnp.int32)
 
+    def step(state, caches, tok, t):
+        with pause_tape():
+            return functional_call(
+                model, state, Tensor._wrap(tok),
+                caches=[Tensor._wrap(c) for c in caches],
+                time_step=Tensor._wrap(t))
 
-def hbm_bw(device) -> float:
-    return _lookup(HBM_BYTES_PER_SEC, device, 819e9)
+    cr = rollup_fn(step, state, caches, tok, jnp.int32(1))
+    kind = getattr(device, "device_kind", "") or "TPU v5e"
+    return 1e3 * cr.predicted_seconds(kind), cr
 
 
 def bench_train(cfg, batch, seq, steps):
@@ -196,6 +191,10 @@ def bench_decode(cfg, on_tpu):
     kv_bytes = cfg.num_layers * 2 * batch * avg_window * cfg.hidden_size * 2
     floor_s = (weight_bytes + kv_bytes) / hbm_bw(dev)
     ms_per_tok = 1e3 * dt / steps
+    # tpucheck cost-model prediction beside the measured number (ISSUE 4):
+    # same jaxpr the chip runs, same byte conventions as the floor —
+    # the ratio says how far the estimator drifts from reality
+    pred_ms, _ = decode_step_cost(model, batch, total, dev)
     out = {
         "decode_tokens_per_sec": round(batch / (ms_per_tok * 1e-3), 1),
         "decode_ms_per_token": round(ms_per_tok, 3),
@@ -203,6 +202,8 @@ def bench_decode(cfg, on_tpu):
         "decode_new_tokens": new,
         "decode_floor_ms_per_token": round(floor_s * 1e3, 3),
         "decode_roofline_frac": round(floor_s * 1e3 / ms_per_tok, 3),
+        "decode_pred_ms_per_token": round(pred_ms, 3),
+        "decode_cost_ratio": round(pred_ms / ms_per_tok, 3),
     }
 
     # weight-only int8 decode (VERDICT r2 #4): same model, int8 projection
@@ -218,9 +219,12 @@ def bench_decode(cfg, on_tpu):
     diffs8 = sorted(timed(new) - timed(short) for _ in range(reps))
     ms8 = 1e3 * diffs8[reps // 2] / steps
     floor8_s = (weight_stream_bytes(model) + kv_bytes) / hbm_bw(dev)
+    pred8_ms, _ = decode_step_cost(model, batch, total, dev)
     out.update({
         "decode_int8w_ms_per_token": round(ms8, 3),
         "decode_int8w_roofline_frac": round(floor8_s * 1e3 / ms8, 3),
+        "decode_int8w_pred_ms_per_token": round(pred8_ms, 3),
+        "decode_int8w_cost_ratio": round(pred8_ms / ms8, 3),
         "quant_backend": quant_backend(rows=batch),
     })
 
@@ -249,9 +253,12 @@ def bench_decode(cfg, on_tpu):
         # int8 weight bytes — the int8w and int4w fractions divide by
         # the same byte model and are directly comparable
         floor4_s = (weight_stream_bytes(model4) + kv_bytes) / hbm_bw(dev)
+        pred4_ms, _ = decode_step_cost(model4, batch, total, dev)
         out.update({
             "decode_int4w_ms_per_token": round(ms4, 3),
             "decode_int4w_roofline_frac": round(floor4_s * 1e3 / ms4, 3),
+            "decode_int4w_pred_ms_per_token": round(pred4_ms, 3),
+            "decode_int4w_cost_ratio": round(pred4_ms / ms4, 3),
         })
     # a roofline fraction above 1.0 is physically impossible — it means
     # the byte model or the timing is wrong; flag loudly rather than ship

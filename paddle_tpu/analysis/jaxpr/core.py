@@ -1,0 +1,503 @@
+"""tpucheck core: findings, the flattened-program IR, and the pass driver.
+
+The passes all want the same view of a traced program: a linear list of
+ops with concrete avals, where the call-like wrappers jax leaves in the
+jaxpr (``pjit``, ``custom_jvp_call``, ``remat`` …) are inlined so a
+buffer's producer and consumers sit in one index space, while the ops
+that genuinely change execution shape (``scan``/``while``/``cond``,
+``shard_map``/``pmap``, ``pallas_call``) survive as single ops carrying
+their sub-jaxprs. :func:`flatten` builds that view once; liveness, the
+cost model and donation analysis all run over it, and the collective
+pass walks the sub-jaxpr structure it preserves.
+
+Unlike tpulint (pure stdlib, pre-trace), this package imports jax by
+design: it runs *after* ``jax.make_jaxpr``, on the program the tracer
+actually built — shapes, dtypes, mesh axes and donation decisions are
+facts here, not guesses.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from ..linter import Violation
+from .rules import JRULES, JaxprRule
+
+__all__ = [
+    "Finding", "AnalysisReport", "FlatOp", "VarRec", "FlatProgram",
+    "flatten", "bytes_of_aval", "analyze_jaxpr", "analyze_fn",
+    "DEFAULT_PASSES", "eqn_source",
+]
+
+
+# ------------------------------------------------------------------ findings
+
+
+@dataclass
+class Finding:
+    """One analysis result, keyed by a stable TPC rule ID.
+
+    Rendered through the tpulint reporter (:meth:`to_violation`) so
+    ``make analyze`` output is line-for-line greppable like ``make
+    lint``: ``entry:op_index:0: TPCxxx message``.
+    """
+
+    rule: str
+    passname: str
+    message: str
+    entry: str = "<jaxpr>"
+    op_index: int = -1          # flattened-program position; -1 = whole program
+    primitive: str = ""
+    source: str = ""            # user file:line from jax source_info, if any
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def severity(self) -> str:
+        return JRULES[self.rule].severity
+
+    def to_violation(self) -> Violation:
+        src = f" [{self.source}]" if self.source else ""
+        return Violation(self.rule, self.entry,
+                         max(self.op_index, 0), 0,
+                         f"{JRULES[self.rule].name}: {self.message}{src}")
+
+
+@dataclass
+class AnalysisReport:
+    entry: str
+    findings: List[Finding] = field(default_factory=list)
+    memory: Optional[Any] = None    # liveness.MemoryEstimate
+    cost: Optional[Any] = None      # cost.CostRollup
+    passes_run: Tuple[str, ...] = ()
+
+    def by_severity(self, *levels: str) -> List[Finding]:
+        return [f for f in self.findings if f.severity in levels]
+
+    def gating(self) -> List[Finding]:
+        """Findings that fail a gate: everything but advisory ``info``."""
+        return self.by_severity("error", "warn")
+
+
+# ------------------------------------------------------------------ flat IR
+
+
+@dataclass
+class VarRec:
+    """One logical buffer in the flattened program."""
+
+    uid: int
+    aval: Any
+    nbytes: int
+    def_idx: int                 # -1 for program inputs/consts
+    last_use: int = -1
+    kind: str = "temp"           # "arg" | "const" | "temp" | "out"
+    materialized: bool = True
+    producer: str = ""           # primitive name
+    source: str = ""
+    reuse_of: Optional["VarRec"] = None   # in-place update: shares a buffer
+    arg_index: int = -1          # flat argument position for kind == "arg"
+
+
+@dataclass
+class FlatOp:
+    index: int
+    prim: str
+    invars: List[Optional[VarRec]]    # None for literals
+    outvars: List[VarRec]
+    params: Dict[str, Any]
+    source: str = ""
+    # extra transient bytes that exist only while this op runs (recursive
+    # peak of a scan/while/cond body, pallas scratch, ...)
+    transient_bytes: int = 0
+
+
+@dataclass
+class FlatProgram:
+    ops: List[FlatOp]
+    invars: List[VarRec]
+    constvars: List[VarRec]
+    outvars: List[VarRec]        # records also appear in ops' outvars
+    all_vars: List[VarRec]
+
+
+def bytes_of_aval(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0  # tokens, abstract refs
+    try:
+        itemsize = dtype.itemsize
+    except AttributeError:
+        return 0
+    n = 1
+    for d in shape:
+        try:
+            n *= int(d)
+        except TypeError:
+            return 0  # symbolic dim (export) — no concrete size
+    return n * itemsize
+
+
+def eqn_source(eqn) -> str:
+    """Best-effort ``file:line`` for an eqn (jax internal API, so guarded)."""
+    try:
+        from jax._src import source_info_util
+
+        s = source_info_util.summarize(eqn.source_info)
+        return s or ""
+    except Exception:
+        return ""
+
+
+# Call-like primitives whose sub-jaxpr executes exactly once, inline, with
+# a 1:1 operand/result correspondence — flattened away entirely.
+_INLINE_CALLS = {
+    "pjit", "closed_call", "core_call", "call", "named_call", "xla_call",
+    "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+    "checkpoint", "remat", "remat2", "custom_lin",
+}
+
+# Ops whose output is a view of an input: no new buffer. (transpose and
+# broadcast DO have different logical bytes, but XLA folds transposes into
+# dot dimension numbers and broadcasts into consumers; modeling them as
+# materializing double-counts against measured temp bytes.)
+_ALIAS_OPS = {
+    "reshape", "squeeze", "expand_dims", "transpose", "rev",
+    "bitcast_convert_type", "stop_gradient", "copy",
+    "broadcast_in_dim", "broadcast", "slice", "real", "imag",
+}
+
+# Elementwise-ish ops XLA fuses into their (single) consumer: the result
+# never hits HBM. With >1 consumer XLA duplicates only cheap ops, so we
+# conservatively materialize those.
+_FUSABLE_OPS = {
+    "add", "sub", "mul", "div", "rem", "pow", "integer_pow", "neg", "abs",
+    "max", "min", "exp", "exp2", "expm1", "log", "log1p", "tanh", "logistic",
+    "sqrt", "rsqrt", "cbrt", "sign", "floor", "ceil", "round", "clamp",
+    "erf", "erfc", "erf_inv", "sin", "cos", "tan", "asin", "acos", "atan",
+    "atan2", "sinh", "cosh", "is_finite", "not", "and", "or", "xor",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "eq", "ne", "ge", "gt", "le", "lt", "select_n", "convert_element_type",
+    "reduce_precision", "nextafter", "square", "iota", "sub", "select",
+}
+
+# In-place-eligible ops: when an input buffer of identical size dies at
+# this op, XLA reuses it for the output (elementwise epilogues, cache
+# updates via dynamic_update_slice, scatter).
+_INPLACE_OPS = _FUSABLE_OPS | {
+    "dynamic_update_slice", "scatter", "scatter-add", "scatter_add",
+    "scatter_mul", "scatter_min", "scatter_max", "cumsum", "cumprod",
+    "cummax", "cummin",
+}
+
+# Control-flow / region ops kept opaque in the flat list (their sub-jaxprs
+# are visited by the passes that care).
+CONTROL_FLOW = {"scan", "while", "cond", "shard_map", "xla_pmap",
+                "pallas_call"}
+
+
+def subjaxprs(params: Dict[str, Any]):
+    """(name, closed-or-raw jaxpr) pairs found in an eqn's params —
+    covers scan/while/cond/shard_map/pjit/custom_* layouts."""
+    out = []
+    for key in ("jaxpr", "call_jaxpr", "cond_jaxpr", "body_jaxpr",
+                "fun_jaxpr"):
+        j = params.get(key)
+        if j is not None and (hasattr(j, "eqns") or hasattr(j, "jaxpr")):
+            out.append((key, j))
+    branches = params.get("branches")
+    if branches:
+        for i, b in enumerate(branches):
+            out.append((f"branches[{i}]", b))
+    return out
+
+
+def _raw(jaxpr):
+    """Underlying raw Jaxpr of a ClosedJaxpr (or the Jaxpr itself)."""
+    return jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+
+def _consts(jaxpr):
+    return jaxpr.consts if hasattr(jaxpr, "consts") else []
+
+
+class _Flattener:
+    def __init__(self):
+        self.ops: List[FlatOp] = []
+        self.all_vars: List[VarRec] = []
+        self._uid = 0
+
+    def new_rec(self, aval, def_idx, kind, producer="", source="",
+                arg_index=-1) -> VarRec:
+        rec = VarRec(self._uid, aval, bytes_of_aval(aval), def_idx,
+                     def_idx, kind, True, producer, source,
+                     arg_index=arg_index)
+        self._uid += 1
+        self.all_vars.append(rec)
+        return rec
+
+    def flatten(self, closed) -> FlatProgram:
+        jaxpr = _raw(closed)
+        env: Dict[Any, VarRec] = {}
+        invars = []
+        for i, v in enumerate(jaxpr.invars):
+            rec = self.new_rec(v.aval, -1, "arg", arg_index=i)
+            env[v] = rec
+            invars.append(rec)
+        constvars = []
+        for v, c in zip(jaxpr.constvars, _consts(closed)):
+            rec = self.new_rec(v.aval, -1, "const")
+            env[v] = rec
+            constvars.append(rec)
+        self._emit(jaxpr, env)
+        outvars = []
+        n = len(self.ops)
+        for v in jaxpr.outvars:
+            rec = self._read(env, v)
+            if rec is not None:
+                rec.kind = "out" if rec.kind == "temp" else rec.kind
+                rec.last_use = n  # outputs live to the end
+                outvars.append(rec)
+        return FlatProgram(self.ops, invars, constvars, outvars,
+                           self.all_vars)
+
+    def _read(self, env, v) -> Optional[VarRec]:
+        from jax._src.core import Literal
+
+        if isinstance(v, Literal):
+            return None
+        return env.get(v)
+
+    def _emit(self, jaxpr, env):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name in _INLINE_CALLS:
+                sub = None
+                for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+                    if key in eqn.params:
+                        sub = eqn.params[key]
+                        break
+                if sub is not None:
+                    self._inline(sub, eqn, env)
+                    continue
+            src = eqn_source(eqn)
+            idx = len(self.ops)
+            ins = [self._read(env, v) for v in eqn.invars]
+            for rec in ins:
+                if rec is not None:
+                    rec.last_use = max(rec.last_use, idx)
+            outs = []
+            for v in eqn.outvars:
+                rec = self.new_rec(v.aval, idx, "temp", name, src)
+                env[v] = rec
+                outs.append(rec)
+            self.ops.append(FlatOp(idx, name, ins, outs, dict(eqn.params),
+                                   src))
+
+    def _inline(self, sub, eqn, env):
+        raw = _raw(sub)
+        inner_env: Dict[Any, VarRec] = {}
+        for v, c in zip(raw.constvars, _consts(sub)):
+            inner_env[v] = self.new_rec(v.aval, -1, "const")
+        from jax._src.core import Literal
+
+        for iv, ov in zip(raw.invars, eqn.invars):
+            if isinstance(ov, Literal):
+                continue
+            rec = env.get(ov)
+            if rec is not None:
+                inner_env[iv] = rec
+        self._emit(raw, inner_env)
+        n = len(self.ops)
+        for outer_v, inner_v in zip(eqn.outvars, raw.outvars):
+            rec = self._read(inner_env, inner_v)
+            if rec is None:
+                # literal output: make a tiny const record
+                rec = self.new_rec(getattr(inner_v, "aval", None) or
+                                   outer_v.aval, -1, "const")
+            env[outer_v] = rec
+            rec.last_use = max(rec.last_use, n - 1)
+
+
+def flatten(closed) -> FlatProgram:
+    """Flatten a ClosedJaxpr (or raw Jaxpr) into the pass-shared IR."""
+    return _Flattener().flatten(closed)
+
+
+def materialize(prog: FlatProgram) -> None:
+    """Decide, for every temp, whether XLA materializes it in HBM.
+
+    Model (validated against ``Compiled.memory_analysis()`` temp+output
+    bytes on real entry points, see test_jaxpr_analysis.py):
+
+    * view ops alias their input — no buffer;
+    * fusable elementwise ops with exactly one consumer fuse forward —
+      no buffer;
+    * everything else materializes;
+    * an in-place-eligible op whose largest same-size input dies at the
+      op *reuses* that buffer (chains transitively), so the pair counts
+      once.
+    """
+    consumers: Dict[int, Set[int]] = {}
+    for op in prog.ops:
+        for rec in op.invars:
+            if rec is not None:
+                consumers.setdefault(rec.uid, set()).add(op.index)
+    out_uids = {r.uid for r in prog.outvars}
+    by_index = {op.index: op for op in prog.ops}
+
+    # a fusable producer streams into consumers that are themselves
+    # fusion-region members (elementwise, views, reduces). A dot/conv/
+    # control-flow/opaque consumer reads operands from HBM, so the
+    # producer's result must land there first.
+    def _fusing_consumer(idx: int) -> bool:
+        op = by_index.get(idx)
+        if op is None:
+            return False
+        return (op.prim in _FUSABLE_OPS or op.prim in _ALIAS_OPS
+                or op.prim.startswith("reduce_")
+                or op.prim in ("select_n", "argmax", "argmin"))
+
+    for op in prog.ops:
+        for rec in op.outvars:
+            if rec.uid in out_uids:
+                rec.materialized = True
+                continue
+            cons = consumers.get(rec.uid, set())
+            if op.prim in _ALIAS_OPS:
+                rec.materialized = False
+                # alias: the input must stay live as long as the view
+                for src in op.invars:
+                    if src is not None:
+                        src.last_use = max(src.last_use, rec.last_use)
+            elif (op.prim in _FUSABLE_OPS and len(cons) <= 1
+                    and all(_fusing_consumer(c) for c in cons)):
+                rec.materialized = False
+            else:
+                rec.materialized = True
+        # in-place reuse: output takes over a dying input's buffer
+        if op.prim in _INPLACE_OPS:
+            for rec in op.outvars:
+                if not rec.materialized:
+                    continue
+                for src in op.invars:
+                    if (src is not None and src.materialized
+                            and src.kind in ("temp", "out")
+                            and src.reuse_of is None
+                            and src.nbytes == rec.nbytes
+                            and src.last_use == op.index):
+                        rec.reuse_of = src
+                        src.last_use = max(src.last_use, rec.last_use)
+                        break
+
+
+# ------------------------------------------------------------------ driver
+
+
+def _default_passes():
+    from . import collectives, cost, donation, liveness
+
+    return (liveness.LivenessPass(), collectives.CollectivePass(),
+            donation.DonationPass(), cost.CostModelPass())
+
+
+DEFAULT_PASSES: Tuple[str, ...] = ("liveness", "collectives", "donation",
+                                   "cost")
+
+
+def analyze_jaxpr(closed, *, entry: str = "<jaxpr>",
+                  mesh=None,
+                  donate_argnums: Sequence[int] = (),
+                  budget_bytes: Optional[int] = None,
+                  device_kind: Optional[str] = None,
+                  passes=None,
+                  top_k: int = 5,
+                  min_donation_bytes: int = 1 << 20) -> AnalysisReport:
+    """Run the tpucheck passes over a traced program.
+
+    ``mesh``: the mesh the program is expected to run under (defaults to
+    the framework's active mesh, ``distributed.parallel.get_mesh()``).
+    ``donate_argnums``: flat argument positions declared donated at the
+    jit entry. ``budget_bytes``: HBM budget for TPC101 (None = don't
+    gate). ``device_kind``: roofline device for the cost model.
+    """
+    if mesh is None:
+        try:
+            from ...distributed.parallel import get_mesh
+
+            mesh = get_mesh()
+        except Exception:
+            mesh = None
+    if passes is None:
+        passes = _default_passes()
+    report = AnalysisReport(entry=entry,
+                            passes_run=tuple(p.name for p in passes))
+    ctx = PassContext(closed=closed, entry=entry, mesh=mesh,
+                      donate_argnums=tuple(donate_argnums),
+                      budget_bytes=budget_bytes, device_kind=device_kind,
+                      top_k=top_k, min_donation_bytes=min_donation_bytes)
+    for p in passes:
+        p.run(ctx, report)
+    report.findings.sort(key=lambda f: (SEV_ORDER[f.severity], f.rule,
+                                        f.op_index))
+    return report
+
+
+SEV_ORDER = {"error": 0, "warn": 1, "info": 2}
+
+
+@dataclass
+class PassContext:
+    closed: Any
+    entry: str
+    mesh: Any
+    donate_argnums: Tuple[int, ...]
+    budget_bytes: Optional[int]
+    device_kind: Optional[str]
+    top_k: int = 5
+    # TPC302 advisory floor: donating a KB-scale buffer is noise
+    min_donation_bytes: int = 1 << 20
+    _flat: Optional[FlatProgram] = None
+
+    @property
+    def flat(self) -> FlatProgram:
+        """The flattened, materialization-annotated program (built once,
+        shared by liveness/donation/cost)."""
+        if self._flat is None:
+            self._flat = flatten(self.closed)
+            materialize(self._flat)
+        return self._flat
+
+
+def analyze_fn(fn: Callable, *args,
+               donate_argnums: Sequence[int] = (),
+               static_argnums: Sequence[int] = (),
+               entry: Optional[str] = None,
+               **analyze_kw) -> AnalysisReport:
+    """Trace ``fn(*args)`` with ``jax.make_jaxpr`` and analyze it.
+
+    ``donate_argnums`` uses the *python argument* positions (like
+    ``jax.jit``); they are expanded to flat-leaf positions so pytree
+    arguments donate every leaf, matching jit semantics.
+    """
+    import jax
+
+    closed = jax.make_jaxpr(fn, static_argnums=tuple(static_argnums))(*args)
+    # expand python-arg donation to flat invar positions
+    donated_flat: List[int] = []
+    if donate_argnums:
+        flat_pos = 0
+        static = set(static_argnums)
+        for i, a in enumerate(args):
+            if i in static:
+                continue
+            nleaves = len(jax.tree_util.tree_leaves(a))
+            if i in set(donate_argnums):
+                donated_flat.extend(range(flat_pos, flat_pos + nleaves))
+            flat_pos += nleaves
+    return analyze_jaxpr(
+        closed,
+        entry=entry or getattr(fn, "__name__", "<fn>"),
+        donate_argnums=donated_flat,
+        **analyze_kw)

@@ -1,0 +1,136 @@
+"""Donation/aliasing analysis over the flattened program.
+
+Donation is jax's only lever for in-place updates: a donated argument's
+buffer may back an output of identical shape/dtype, halving peak HBM for
+the params/optimizer-state pattern. Three failure shapes, all invisible
+until the chip:
+
+* TPC301 (no alias target) — donated but no output matches the buffer's
+  shape/dtype, so XLA cannot reuse it anywhere. The caller's array is
+  invalidated anyway AND fresh memory is allocated — strictly worse
+  than not donating. XLA only tells you in a buried runtime log line.
+* TPC301 (still read) — donated and an output matches, but every such
+  output is produced *before* the argument's last read: honoring the
+  alias would clobber a value the program still needs, so XLA inserts a
+  silent defensive copy — the donation saves nothing.
+* TPC302 (advisory) — donation left on the table: an argument's last
+  read happens before some same-shape/dtype output is produced and no
+  donated argument has claimed that output. Declaring ``donate_argnums``
+  there is a copy-free in-place update worth the buffer's bytes.
+
+Matching is greedy over (shape, dtype) with def/use ordering, mirroring
+the granularity of XLA's input-output alias assignment. Arguments
+returned *unchanged* (identity passthrough) are excluded — they alias
+trivially and donating them buys nothing.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from .core import Finding, PassContext, VarRec
+from . import rules as R
+from .liveness import _fmt_bytes
+
+__all__ = ["DonationPass"]
+
+
+def _sig(aval) -> Tuple[Tuple[int, ...], str]:
+    return (tuple(int(d) for d in getattr(aval, "shape", ())),
+            str(getattr(aval, "dtype", "?")))
+
+
+def _fmt_sig(sig) -> str:
+    return f"{sig[1]}[{','.join(map(str, sig[0]))}]"
+
+
+class DonationPass:
+    name = "donation"
+
+    def run(self, ctx: PassContext, report) -> None:
+        prog = ctx.flat
+        donated = set(ctx.donate_argnums)
+        out_uids = {r.uid for r in prog.outvars}
+
+        # unclaimed outputs per signature (skip arg passthroughs — those
+        # satisfy aliasing by identity)
+        pool: Dict[Tuple, List[VarRec]] = {}
+        for r in prog.outvars:
+            if r.kind == "arg":
+                continue
+            pool.setdefault(_sig(r.aval), []).append(r)
+        for outs in pool.values():
+            outs.sort(key=lambda r: r.def_idx)  # earliest producer first
+
+        def claim(sig, min_def_idx):
+            """Pop an unclaimed matching output; prefer one produced at or
+            after ``min_def_idx`` (copy-free alias)."""
+            outs = pool.get(sig) or []
+            for i, r in enumerate(outs):
+                if r.def_idx >= min_def_idx:
+                    return outs.pop(i), True
+            if outs:
+                return outs.pop(0), False
+            return None, False
+
+        # donated args first — they own the alias slots
+        for rec in prog.invars:
+            if rec.arg_index not in donated or rec.uid in out_uids:
+                continue
+            sig = _sig(rec.aval)
+            out, copy_free = claim(sig, rec.last_use)
+            if out is None:
+                report.findings.append(Finding(
+                    R.WASTED_DONATION.id, self.name,
+                    f"argument {rec.arg_index} ({_fmt_sig(sig)}, "
+                    f"{_fmt_bytes(rec.nbytes)}) is donated but no output "
+                    f"matches its shape/dtype — XLA cannot reuse the "
+                    f"buffer; the caller loses the array and the program "
+                    f"allocates fresh memory anyway",
+                    entry=ctx.entry,
+                    data={"arg_index": rec.arg_index, "why": "no_target",
+                          "shape": list(sig[0]), "dtype": sig[1],
+                          "nbytes": rec.nbytes}))
+            elif not copy_free:
+                report.findings.append(Finding(
+                    R.WASTED_DONATION.id, self.name,
+                    f"argument {rec.arg_index} ({_fmt_sig(sig)}, "
+                    f"{_fmt_bytes(rec.nbytes)}) is donated but still read "
+                    f"at op {rec.last_use}, after its alias target is "
+                    f"produced at op {out.def_idx} — XLA honors the "
+                    f"donation with a silent defensive copy; the donation "
+                    f"saves nothing",
+                    entry=ctx.entry, op_index=rec.last_use,
+                    data={"arg_index": rec.arg_index, "why": "still_read",
+                          "last_use": rec.last_use,
+                          "target_def": out.def_idx,
+                          "nbytes": rec.nbytes}))
+
+        # then non-donated dead-in-time args against what remains
+        missed: List[Tuple[int, int, Tuple]] = []
+        for rec in prog.invars:
+            if rec.arg_index in donated or rec.uid in out_uids:
+                continue
+            if rec.nbytes < ctx.min_donation_bytes:
+                continue  # advisory floor: KB-scale donations are noise
+            out, copy_free = claim(_sig(rec.aval), rec.last_use)
+            if out is not None and copy_free:
+                missed.append((rec.arg_index, rec.nbytes, _sig(rec.aval)))
+            elif out is not None:
+                # put it back — a copy-forcing donation is not advice
+                pool.setdefault(_sig(rec.aval), []).insert(0, out)
+        if missed:
+            total = sum(n for _, n, _ in missed)
+            ids = [i for i, _, _ in missed]
+            report.findings.append(Finding(
+                R.MISSED_DONATION.id, self.name,
+                f"{len(missed)} argument(s) {ids[:8]} are last read before "
+                f"a matching output is produced and no donation claims "
+                f"that output — donate_argnums there is a copy-free "
+                f"in-place update worth up to {_fmt_bytes(total)} of "
+                f"peak HBM",
+                entry=ctx.entry,
+                data={"arg_indices": ids, "savings_bytes": total,
+                      "per_arg": [
+                          {"arg_index": i, "nbytes": n,
+                           "shape": list(s[0]), "dtype": s[1]}
+                          for i, n, s in missed]}))

@@ -1,0 +1,78 @@
+"""FLAGS_analyze_on_compile — trace-time analysis at jit entry points.
+
+``StaticFunction`` calls :func:`analyze_and_record` at every FIRST trace
+of a program signature (the moment the shape/dtype combination is new
+and jax is about to pay a compile anyway — one extra ``make_jaxpr`` is
+noise next to XLA). Findings are:
+
+* counted into the metrics registry as
+  ``paddle_tpu_analysis_findings_total{pass,rule}`` (PR 3 pipeline: a
+  dashboard can alert on a nonzero TPC201 the same way it alerts on
+  retraces);
+* error/warn findings logged through ``warnings`` so an interactive run
+  sees them at the trace, not in a post-mortem.
+
+Analysis failures never break the entry point: the wrapped call is
+already compiled and correct; this hook is advisory instrumentation and
+its own crash is counted (``paddle_tpu_analysis_failures_total``) and
+warned, not raised.
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Optional
+
+__all__ = ["analyze_on_compile_enabled", "analyze_and_record"]
+
+_METRICS: Optional[dict] = None
+
+
+def _metrics() -> dict:
+    global _METRICS
+    if _METRICS is None:
+        from ...observability import counter
+
+        _METRICS = {
+            "findings": counter(
+                "paddle_tpu_analysis_findings_total",
+                "tpucheck findings discovered at first trace, by pass "
+                "and TPC rule", labelnames=("pass", "rule")),
+            "runs": counter(
+                "paddle_tpu_analysis_runs_total",
+                "jit entry first-traces analyzed by tpucheck"),
+            "failures": counter(
+                "paddle_tpu_analysis_failures_total",
+                "tpucheck hook crashes (analysis skipped, entry "
+                "unaffected)"),
+        }
+    return _METRICS
+
+
+def analyze_on_compile_enabled() -> bool:
+    from ...framework.flags import get_flags
+
+    return bool(get_flags("FLAGS_analyze_on_compile")
+                ["FLAGS_analyze_on_compile"])
+
+
+def analyze_and_record(fn: Callable, args: tuple, entry: str) -> None:
+    """Trace ``fn(*args)``, run the passes, count + warn on findings."""
+    m = _metrics()
+    try:
+        from .core import analyze_fn
+
+        report = analyze_fn(fn, *args, entry=entry)
+        m["runs"].inc()
+        for f in report.findings:
+            m["findings"].labels(**{"pass": f.passname, "rule": f.rule}
+                                 ).inc()
+            if f.severity in ("error", "warn"):
+                warnings.warn(
+                    f"tpucheck [{entry}] {f.rule}: {f.message}",
+                    RuntimeWarning, stacklevel=3)
+    except Exception as e:
+        m["failures"].inc()
+        warnings.warn(
+            f"tpucheck hook failed for {entry!r} ({type(e).__name__}: "
+            f"{e}); the compiled entry is unaffected", RuntimeWarning,
+            stacklevel=3)

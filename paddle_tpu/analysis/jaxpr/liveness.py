@@ -1,0 +1,192 @@
+"""Peak-memory liveness over the flattened program.
+
+Backward liveness (each buffer lives from its defining op to its last
+consumer; program outputs live to the end) over the materialization
+model in :mod:`core`, yielding a peak-HBM estimate and the top-k live
+buffers at the high-water mark. The temp+output component is validated
+against ``Compiled.memory_analysis()`` on real entry points in
+``tests/test_jaxpr_analysis.py`` — the model is only trusted because
+that test holds it within the acceptance band.
+
+Control flow contributes transient bytes: a scan/while body's own peak
+exists only while the loop runs (XLA allocates the body arena inside
+the loop), a cond contributes the worst branch.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .core import (FlatOp, FlatProgram, Finding, PassContext, flatten,
+                   materialize)
+from . import rules as R
+
+__all__ = ["LivenessPass", "MemoryEstimate", "LiveBuffer", "estimate_memory"]
+
+
+@dataclass
+class LiveBuffer:
+    nbytes: int
+    shape: Tuple[int, ...]
+    dtype: str
+    kind: str          # arg | const | temp | out
+    producer: str      # primitive ('' for args)
+    source: str
+
+    def describe(self) -> str:
+        where = f" @ {self.source}" if self.source else ""
+        prod = self.producer or self.kind
+        return (f"{self.dtype}[{','.join(map(str, self.shape))}] "
+                f"{_fmt_bytes(self.nbytes)} <- {prod}{where}")
+
+
+@dataclass
+class MemoryEstimate:
+    peak_bytes: int            # args + consts + live temps/outputs at peak
+    peak_temp_out_bytes: int   # temps + outputs only (memory_analysis axis)
+    arg_bytes: int
+    const_bytes: int
+    out_bytes: int
+    peak_op_index: int
+    peak_op: str
+    high_water: List[LiveBuffer] = field(default_factory=list)
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n}B"
+        n /= 1024
+    return f"{n}B"
+
+
+def _inner_transients(op: FlatOp) -> int:
+    """Recursive temp-peak of a control-flow op's sub-program(s): bytes
+    that exist only while this op runs, on top of its operands/results."""
+    if op.prim == "scan":
+        body = op.params.get("jaxpr")
+        if body is None:
+            return 0
+        est = estimate_memory(body)
+        # double-buffered carries: new-carry temps are already in the
+        # body's temp peak; the stacked ys live in the outer frame
+        return est.peak_temp_out_bytes
+    if op.prim == "while":
+        total = 0
+        for key in ("cond_jaxpr", "body_jaxpr"):
+            sub = op.params.get(key)
+            if sub is not None:
+                total = max(total, estimate_memory(sub).peak_temp_out_bytes)
+        return total
+    if op.prim == "cond":
+        branches = op.params.get("branches") or ()
+        return max((estimate_memory(b).peak_temp_out_bytes
+                    for b in branches), default=0)
+    if op.prim in ("shard_map", "xla_pmap"):
+        sub = op.params.get("jaxpr") or op.params.get("call_jaxpr")
+        if sub is not None:
+            return estimate_memory(sub).peak_temp_out_bytes
+    return 0
+
+
+def estimate_memory(closed, prog: Optional[FlatProgram] = None,
+                    top_k: int = 5) -> MemoryEstimate:
+    """Liveness peak over one (closed) jaxpr."""
+    if prog is None:
+        prog = flatten(closed)
+        materialize(prog)
+    arg_bytes = sum(r.nbytes for r in prog.invars)
+    const_bytes = sum(r.nbytes for r in prog.constvars)
+    out_bytes = sum(r.nbytes for r in prog.outvars)
+
+    # event sweep: bytes enter at def, leave after last use. Args/consts
+    # are resident for the whole program and tracked separately.
+    n = len(prog.ops)
+    delta = [0] * (n + 2)
+    for rec in prog.all_vars:
+        if rec.kind in ("arg", "const") or not rec.materialized:
+            continue
+        if rec.reuse_of is not None:
+            continue  # shares its donor's buffer; donor's lifetime extended
+        start = max(rec.def_idx, 0)
+        end = rec.last_use
+        if end < start:
+            end = start  # dead store still exists for the op's duration
+        delta[start] += rec.nbytes
+        delta[end + 1] -= rec.nbytes
+
+    peak = 0
+    peak_idx = 0
+    cur = 0
+    transients = {op.index: _inner_transients(op) for op in prog.ops
+                  if op.prim in ("scan", "while", "cond", "shard_map",
+                                 "xla_pmap")}
+    for i in range(n):
+        cur += delta[i]
+        here = cur + transients.get(i, 0)
+        if here > peak:
+            peak = here
+            peak_idx = i
+
+    # top-k live buffers at the peak op
+    live: List[LiveBuffer] = []
+    for rec in prog.all_vars:
+        if not rec.materialized or rec.reuse_of is not None:
+            continue
+        if rec.kind in ("arg", "const"):
+            continue
+        if max(rec.def_idx, 0) <= peak_idx <= rec.last_use:
+            aval = rec.aval
+            live.append(LiveBuffer(
+                rec.nbytes, tuple(getattr(aval, "shape", ())),
+                str(getattr(aval, "dtype", "?")), rec.kind,
+                rec.producer, rec.source))
+    live.sort(key=lambda b: -b.nbytes)
+
+    peak_op = prog.ops[peak_idx].prim if prog.ops else ""
+    return MemoryEstimate(
+        peak_bytes=peak + arg_bytes + const_bytes,
+        peak_temp_out_bytes=peak,
+        arg_bytes=arg_bytes,
+        const_bytes=const_bytes,
+        out_bytes=out_bytes,
+        peak_op_index=peak_idx,
+        peak_op=peak_op,
+        high_water=live[:top_k],
+    )
+
+
+class LivenessPass:
+    name = "liveness"
+
+    def run(self, ctx: PassContext, report) -> None:
+        est = estimate_memory(ctx.closed, ctx.flat, top_k=ctx.top_k)
+        report.memory = est
+        top = "; ".join(b.describe() for b in est.high_water) or "<empty>"
+        report.findings.append(Finding(
+            R.HIGH_WATER_REPORT.id, self.name,
+            f"peak {_fmt_bytes(est.peak_bytes)} "
+            f"(args {_fmt_bytes(est.arg_bytes)} + temps/outputs "
+            f"{_fmt_bytes(est.peak_temp_out_bytes)}) at op "
+            f"{est.peak_op_index} ({est.peak_op}); top live: {top}",
+            entry=ctx.entry, op_index=est.peak_op_index,
+            primitive=est.peak_op,
+            data={
+                "peak_bytes": est.peak_bytes,
+                "peak_temp_out_bytes": est.peak_temp_out_bytes,
+                "arg_bytes": est.arg_bytes,
+                "out_bytes": est.out_bytes,
+                "high_water": [b.describe() for b in est.high_water],
+            }))
+        if ctx.budget_bytes is not None and est.peak_bytes > ctx.budget_bytes:
+            report.findings.append(Finding(
+                R.PEAK_OVER_BUDGET.id, self.name,
+                f"estimated peak {_fmt_bytes(est.peak_bytes)} exceeds the "
+                f"budget {_fmt_bytes(ctx.budget_bytes)} by "
+                f"{_fmt_bytes(est.peak_bytes - ctx.budget_bytes)}; "
+                f"largest live buffer: "
+                f"{est.high_water[0].describe() if est.high_water else '?'}",
+                entry=ctx.entry, op_index=est.peak_op_index,
+                primitive=est.peak_op,
+                data={"peak_bytes": est.peak_bytes,
+                      "budget_bytes": ctx.budget_bytes}))

@@ -1,0 +1,122 @@
+"""tpucheck rule registry — jaxpr-level program analysis findings.
+
+``TPC`` IDs are the traced-program siblings of the source-level ``TPL``
+catalogue (``paddle_tpu/analysis/rules.py``): tpulint sees what the
+*source* says, tpucheck sees what the tracer actually *built* — concrete
+buffer sizes, mesh axes, dtypes, donation decisions. Same stability
+contract: IDs are load-bearing (suppressions, golden fixtures, README,
+metrics labels key on them) — never renumber, retire and mint instead.
+
+Families (first digit):
+
+* ``1xx`` — memory: peak-HBM liveness over the traced program. An OOM
+  caught here costs seconds; on the chip it costs a 15-minute compile
+  followed by a crash.
+* ``2xx`` — collectives: axis names vs the active mesh, and collectives
+  reachable only under value-dependent control flow — the multi-host
+  deadlock shapes (one host enters the psum, its peers never do).
+* ``3xx`` — donation/aliasing: donated buffers XLA cannot actually
+  reuse (silent copy) and dead arguments that were never donated
+  (missed in-place update).
+* ``4xx`` — cost model: roofline FLOPs/HBM-bytes rollup; dtype choices
+  that fall off the TPU fast path.
+
+Severities: ``error`` findings are certainly wrong programs, ``warn``
+findings are hazards that need a justification to ship, ``info``
+findings are advisory data (they never gate ``make analyze``).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+__all__ = ["JaxprRule", "JRULES", "SEVERITIES"]
+
+SEVERITIES = ("error", "warn", "info")
+
+
+@dataclass(frozen=True)
+class JaxprRule:
+    id: str
+    family: str
+    name: str
+    severity: str
+    description: str
+
+
+JRULES: Dict[str, JaxprRule] = {}
+
+
+def _rule(id: str, family: str, name: str, severity: str,
+          description: str) -> JaxprRule:
+    assert severity in SEVERITIES
+    r = JaxprRule(id, family, name, severity, description)
+    JRULES[id] = r
+    return r
+
+
+PEAK_OVER_BUDGET = _rule(
+    "TPC101", "memory", "peak-memory-over-budget", "error",
+    "the liveness estimate of peak HBM (arguments + live temporaries + "
+    "outputs) exceeds the configured budget. This program will OOM at "
+    "run time; shrink the batch, add rematerialization, or shard the "
+    "state before paying the XLA compile to find out.")
+
+HIGH_WATER_REPORT = _rule(
+    "TPC102", "memory", "high-water-live-set", "info",
+    "advisory: the top-k largest live buffers at the peak-memory program "
+    "point, with the producing primitive and source line of each — the "
+    "first place to look when TPC101 fires or the chip OOMs.")
+
+UNKNOWN_COLLECTIVE_AXIS = _rule(
+    "TPC201", "collective", "collective-axis-not-in-mesh", "error",
+    "a collective names a mesh axis that neither an enclosing "
+    "shard_map/pmap binds against the active mesh nor the mesh itself "
+    "defines. The program was written for a different mesh topology; on "
+    "a real slice this is a launch failure or a wrong-group reduction.")
+
+COLLECTIVE_UNDER_VALUE_DEP = _rule(
+    "TPC202", "collective", "collective-under-value-dependent-branch", "warn",
+    "a collective is reachable only under a value-dependent cond/while "
+    "branch. If the predicate diverges across hosts (it is computed from "
+    "per-host data), some hosts enter the collective and the rest never "
+    "do — the canonical multi-host deadlock. Hoist the collective out of "
+    "the branch or make the predicate provably replicated.")
+
+MALFORMED_PPERMUTE = _rule(
+    "TPC203", "collective", "malformed-ppermute", "error",
+    "a ppermute permutation is not a partial permutation of the axis: "
+    "a (src, dst) index is outside the axis size, or a source/destination "
+    "appears twice. jax traces this without complaint and the program "
+    "hangs or drops data on the chip.")
+
+WASTED_DONATION = _rule(
+    "TPC301", "donation", "donated-buffer-not-reusable", "warn",
+    "an argument is donated but no output matches its shape/dtype, so "
+    "XLA cannot alias it into any result: the caller loses the buffer "
+    "AND the program allocates fresh memory — strictly worse than not "
+    "donating. (XLA logs this as a silent runtime warning; here it is "
+    "caught at trace time.)")
+
+MISSED_DONATION = _rule(
+    "TPC302", "donation", "missed-donation-opportunity", "info",
+    "advisory: an argument is dead by the end of the program and an "
+    "output of identical shape/dtype exists, but the argument was not "
+    "donated. Donating it lets XLA update in place and cuts peak HBM by "
+    "the buffer size — the train-step params/optimizer-state pattern.")
+
+MEMORY_BOUND = _rule(
+    "TPC401", "cost", "memory-bound-program", "info",
+    "advisory: the roofline rollup puts the program's arithmetic "
+    "intensity below the device ridge point — the program is HBM-"
+    "bandwidth-bound and the predicted-time model divides bytes by "
+    "bandwidth, not FLOPs by peak. Expected for decode; a surprise for "
+    "a train step.")
+
+F64_COMPUTE = _rule(
+    "TPC402", "cost", "float64-compute", "warn",
+    "a dot/conv/reduce computes in float64. TPUs have no f64 ALUs — XLA "
+    "emulates it an order of magnitude slower than f32 and doubles the "
+    "HBM stream. Almost always an accidental promotion (a python float, "
+    "np.float64 constant, or x64 mode); cast to f32/bf16 at the "
+    "boundary.")

@@ -591,7 +591,138 @@ class _ModuleAnalyzer:
                                       f"global {base.id!r} in traced "
                                       f"function {fi.qualname!r}")
 
+    # -- TPL304: donated argument re-read after the jitted call ------------
+
+    @staticmethod
+    def _donated_positions(call: ast.Call):
+        """(positions, names) declared by donate_argnums/donate_argnames
+        keywords of a jit/pjit call, or None when the call donates
+        nothing (or non-literally)."""
+        pos: Set[int] = set()
+        names: Set[str] = set()
+        found = False
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                found = True
+                v = kw.value
+                elems = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                    else [v]
+                for e in elems:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, int):
+                        pos.add(e.value)
+            elif kw.arg == "donate_argnames":
+                found = True
+                v = kw.value
+                elems = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                    else [v]
+                for e in elems:
+                    if isinstance(e, ast.Constant) and isinstance(
+                            e.value, str):
+                        names.add(e.value)
+        return (pos, names) if found else None
+
+    def _collect_donating_wrappers(self, scope_node):
+        """name -> (donated positions, donated kwarg names) for jitted
+        callables bound in this scope: ``g = jax.jit(f, donate_argnums=…)``
+        assignments and ``@partial(jax.jit, donate_argnums=…)``-decorated
+        defs."""
+        wrappers: Dict[str, Tuple[Set[int], Set[str]]] = {}
+        for n in _walk_shallow(scope_node):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _is_tracing_expr(n.value.func):
+                d = self._donated_positions(n.value)
+                if d is not None:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            wrappers[t.id] = d
+        for child in ast.iter_child_nodes(scope_node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in child.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        d = None
+                        if _is_tracing_expr(dec.func):
+                            d = self._donated_positions(dec)
+                        elif _tail_name(dec.func) == "partial" and dec.args \
+                                and _is_tracing_expr(dec.args[0]):
+                            d = self._donated_positions(dec)
+                        if d is not None:
+                            wrappers[child.name] = d
+        return wrappers
+
+    def _check_donation_reread(self, scope_node, scope_name: str,
+                               outer_wrappers=None):
+        """Within one function body (not descending into nested defs):
+        find jitted-callable calls that donate, the Name arguments they
+        donate, and any later read of those names without a rebind in
+        between. Line-number ordering is the approximation — the standard
+        linter tradeoff."""
+        wrappers = dict(outer_wrappers or {})
+        wrappers.update(self._collect_donating_wrappers(scope_node))
+        body = list(_walk_shallow(scope_node))
+
+        # donated (name, call) pairs in this scope
+        donations = []  # (argname, call_end_line, callee_repr)
+        for n in body:
+            if not isinstance(n, ast.Call):
+                continue
+            d = None
+            callee = None
+            if isinstance(n.func, ast.Name) and n.func.id in wrappers:
+                d = wrappers[n.func.id]
+                callee = n.func.id
+            elif isinstance(n.func, ast.Call) and _is_tracing_expr(
+                    n.func.func):
+                # inline: jax.jit(f, donate_argnums=(0,))(a, b)
+                d = self._donated_positions(n.func)
+                callee = _dotted(n.func.func) or "jit"
+            if d is None:
+                continue
+            pos, kwnames = d
+            end = getattr(n, "end_lineno", n.lineno)
+            for i, a in enumerate(n.args):
+                if i in pos and isinstance(a, ast.Name):
+                    donations.append((a.id, end, callee))
+            for kw in n.keywords:
+                if kw.arg in kwnames and isinstance(kw.value, ast.Name):
+                    donations.append((kw.value.id, end, callee))
+        if not donations:
+            return
+
+        # later loads vs rebinds of each donated name
+        loads: Dict[str, List[ast.Name]] = {}
+        stores: Dict[str, List[int]] = {}
+        donated_names = {name for name, _, _ in donations}
+        for n in body:
+            if isinstance(n, ast.Name) and n.id in donated_names:
+                if isinstance(n.ctx, ast.Load):
+                    loads.setdefault(n.id, []).append(n)
+                else:
+                    stores.setdefault(n.id, []).append(n.lineno)
+        for name, call_end, callee in donations:
+            for load in loads.get(name, ()):
+                if load.lineno <= call_end:
+                    continue
+                # a store at the call line itself is the canonical
+                # ``params, loss = step(params, x)`` rebind
+                if any(call_end <= s <= load.lineno
+                       for s in stores.get(name, ())):
+                    continue  # rebound from the call's results — the
+                    # correct donation pattern
+                self._add(R.DONATED_ARG_REREAD, load,
+                          f"{name!r} was donated to {callee!r} (line "
+                          f"{call_end}) and is read again in "
+                          f"{scope_name!r} without being rebound — the "
+                          f"buffer no longer belongs to this frame")
+
     def _check_module_wide(self):
+        # TPL304: module-bound donating wrappers are callable from any
+        # function below, so function scopes inherit the module's set
+        module_wrappers = self._collect_donating_wrappers(self.tree)
+        self._check_donation_reread(self.tree, "<module>", {})
+        for fi in self.funcs:
+            self._check_donation_reread(fi.node, fi.qualname,
+                                        module_wrappers)
         # TPL303 — unhashable static kwargs at to_static entry call sites
         for n in ast.walk(self.tree):
             if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \

@@ -86,6 +86,15 @@ UNHASHABLE_STATIC_ARG = _rule(
     "must be hashable; a fresh literal per call is at best a recompile per "
     "call, at worst a TypeError. Pass a tuple or hoist it to a constant.")
 
+DONATED_ARG_REREAD = _rule(
+    "TPL304", "recompile", "donated-arg-reread",
+    "an argument donated to a jitted call (donate_argnums/donate_argnames) "
+    "is read again later in the same function body without being rebound: "
+    "donation invalidates the caller's buffer, so the read is a "
+    "RuntimeError on TPU (deleted array) or a silent defensive copy. "
+    "Rebind the name from the call's results (params = step(params, ...)) "
+    "or drop the donation. Source-level shadow of the jaxpr-level TPC301.")
+
 GLOBAL_WRITE = _rule(
     "TPL401", "side-effect", "traced-global-write",
     "global/nonlocal write inside traced code escapes the functional "

@@ -30,6 +30,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ...jax_compat import (axis_size as compat_axis_size,
+                           shard_map as compat_shard_map)
+
 __all__ = [
     "ring_attention",
     "ring_attention_op",
@@ -61,11 +64,11 @@ def _ring_mapped(mesh, axis_name: str, causal: bool, scale: float,
         _ring_body_flash if impl == "flash" else _ring_body,
         axis_name=axis_name, causal=causal, scale=scale,
     )
-    return jax.shard_map(
-        body, mesh=mesh,
+    return compat_shard_map(
+        body, mesh,
         in_specs=(seq_spec, seq_spec, seq_spec, pos_spec, pos_spec),
         out_specs=seq_spec,
-        axis_names={axis_name}, check_vma=False,
+        axis_names={axis_name},
     )
 
 
@@ -104,7 +107,7 @@ def _ring_drive(k, v, kv_pos, axis_name, attend, merge):
     attend; merge).  ``attend(k_c, v_c, kv_pos_c) -> partial`` and
     ``merge(acc, partial) -> acc`` define the per-impl math; jax transposes
     the ring for gradients."""
-    world = jax.lax.axis_size(axis_name)
+    world = compat_axis_size(axis_name)
     perm = [(i, (i + 1) % world) for i in range(world)]
     acc = attend(k, v, kv_pos)
 
@@ -297,9 +300,9 @@ def _ulysses_mapped(mesh, axis_name: str, causal: bool, scale: float,
         return _a2a(o, axis_name, 1, 2)  # back to seq-sharded
 
     seq_spec = P(None, axis_name, None, None)
-    return jax.shard_map(
-        body, mesh=mesh, in_specs=(seq_spec,) * 3, out_specs=seq_spec,
-        axis_names={axis_name}, check_vma=False,
+    return compat_shard_map(
+        body, mesh, in_specs=(seq_spec,) * 3, out_specs=seq_spec,
+        axis_names={axis_name},
     )
 
 

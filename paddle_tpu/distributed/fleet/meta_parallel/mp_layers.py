@@ -31,18 +31,34 @@ __all__ = [
 
 # ParallelCrossEntropy must know whether it is being traced inside an
 # already-manual (shard_map) region to avoid a rejected nested shard_map.
-# Probe the PUBLIC detection API once at import and hard-fail with a clear
-# message if the installed jax dropped it (ADVICE r3/r4: no private-API
-# probe, no silent degradation on drift).
-if not (hasattr(jax.sharding, "get_abstract_mesh")
-        and hasattr(jax.sharding, "AxisType")):  # pragma: no cover
-    raise ImportError(
-        "paddle_tpu.distributed.fleet.meta_parallel.mp_layers requires "
-        "jax.sharding.get_abstract_mesh and jax.sharding.AxisType (public "
-        f"since jax 0.4.35; installed jax {jax.__version__} lacks them). "
-        "ParallelCrossEntropy's manual-region detection cannot work — "
-        "install a compatible jax rather than risking a silent fallback "
-        "to full-vocab-logits cross entropy.")
+# Two detection generations, resolved ONCE at import (no per-call
+# hasattr):
+#
+# * jax >= 0.5-era: the public abstract-mesh API
+#   (jax.sharding.get_abstract_mesh + AxisType.Manual).
+# * jax 0.4.x (this image ships 0.4.37, which predates that API): the
+#   axis environment — inside a shard_map trace every mesh axis the map
+#   binds appears in ``jax._src.core.get_axis_env().axis_sizes``; outside
+#   it is empty. Narrow private probe, version-gated, and NOT silent: if
+#   neither generation's hook exists the import still hard-fails below,
+#   and a detection miss at run time is caught + counted by
+#   ParallelCrossEntropy's loud fallback path rather than swallowed.
+_NEW_MANUAL_API = (hasattr(jax.sharding, "get_abstract_mesh")
+                   and hasattr(jax.sharding, "AxisType"))
+if not _NEW_MANUAL_API:
+    try:
+        from jax._src.core import get_axis_env as _get_axis_env
+
+        _get_axis_env().axis_sizes  # probe the shape we rely on
+    except Exception as _e:  # pragma: no cover
+        raise ImportError(
+            "paddle_tpu.distributed.fleet.meta_parallel.mp_layers needs a "
+            "manual-region detection hook: jax.sharding.get_abstract_mesh/"
+            f"AxisType (jax >= 0.4.35-era) or the 0.4.x axis env (probe "
+            f"failed: {_e!r}; installed jax {jax.__version__}). "
+            "ParallelCrossEntropy cannot avoid nested shard_map — install "
+            "a compatible jax rather than risking a silent fallback to "
+            "full-vocab-logits cross entropy.") from _e
 
 
 class VocabParallelEmbedding(nn.Layer):
@@ -135,11 +151,11 @@ def _pce_mapped(mesh, axis_name: str):
     logits sharded on vocab; other mesh axes stay in GSPMD auto mode."""
     body = functools.partial(parallel_cross_entropy_shardmap,
                              axis_name=axis_name)
-    mapped = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(None, axis_name), P(None)), out_specs=P(None),
-        axis_names={axis_name}, check_vma=False,
-    )
+    from ...jax_compat import shard_map as _compat_shard_map
+
+    mapped = _compat_shard_map(
+        body, mesh, in_specs=(P(None, axis_name), P(None)),
+        out_specs=P(None), axis_names={axis_name})
     return jax.jit(mapped)
 
 
@@ -178,9 +194,14 @@ class ParallelCrossEntropy(nn.Layer):
 
     @staticmethod
     def _inside_manual_region() -> bool:
-        cur = jax.sharding.get_abstract_mesh()
-        return bool(cur is not None and getattr(cur, "axis_types", None)
-                    and jax.sharding.AxisType.Manual in cur.axis_types)
+        if _NEW_MANUAL_API:
+            cur = jax.sharding.get_abstract_mesh()
+            return bool(cur is not None and getattr(cur, "axis_types", None)
+                        and jax.sharding.AxisType.Manual in cur.axis_types)
+        # jax 0.4.x: a nonempty axis env means some enclosing map
+        # (shard_map / pmap / named vmap) already binds named axes —
+        # a nested shard_map over the original mesh would be rejected
+        return bool(_get_axis_env().axis_sizes)
 
     @classmethod
     def reset_fallback_count(cls):

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...jax_compat import shard_map as compat_shard_map
 from ....framework.tensor import Tensor, pause_tape
 from ....nn.clip import ClipGradByGlobalNorm
 from .meta_parallel_base import MetaParallelBase
@@ -548,13 +549,12 @@ class PipelineParallel(MetaParallelBase):
                 body_specs = jax.tree_util.tree_map(
                     lambda _: P("pp"), body_state
                 )
-                acc = jax.shard_map(
+                acc = compat_shard_map(
                     pipe,
-                    mesh=mesh,
+                    mesh,
                     in_specs=(body_specs, P()),
                     out_specs=P(),
                     axis_names={"pp"},
-                    check_vma=False,
                 )(body_state, xs)
                 h = Tensor._wrap(acc.reshape(full))
             else:
@@ -634,13 +634,12 @@ class PipelineParallel(MetaParallelBase):
         body_specs = jax.tree_util.tree_map(lambda _: P("pp"), body_state)
         prepost_specs = jax.tree_util.tree_map(lambda _: P(), prepost)
         with pause_tape():
-            dpp, dbody, lsum = jax.shard_map(
+            dpp, dbody, lsum = compat_shard_map(
                 pipe,
-                mesh=mesh,
+                mesh,
                 in_specs=(prepost_specs, body_specs, P(), P(), P()),
                 out_specs=(prepost_specs, body_specs, P()),
                 axis_names={"pp"},
-                check_vma=False,
             )(prepost, body_state, xs, ys, scale)
         grads = dict(dpp)
         grads.update({f"b::{n}": g for n, g in dbody.items()})

@@ -49,8 +49,10 @@ def _seq_spec(x, axis_name="mp"):
 def _mesh_has_axes(spec) -> bool:
     """True when the ambient (abstract) mesh defines every axis the spec
     names — the condition under which with_sharding_constraint is legal."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or not mesh.axis_names:
+    from ...jax_compat import ambient_mesh_axis_names
+
+    axis_names = ambient_mesh_axis_names()
+    if not axis_names:
         return False
     named = set()
     for entry in spec:
@@ -58,7 +60,7 @@ def _mesh_has_axes(spec) -> bool:
             continue
         for a in (entry if isinstance(entry, (tuple, list)) else (entry,)):
             named.add(a)
-    return named.issubset(set(mesh.axis_names))
+    return named.issubset(set(axis_names))
 
 
 def _maybe_constraint(arr, spec):

@@ -64,7 +64,9 @@ def init_parallel_env(strategy=None):
     from ..framework.compile_cache import maybe_enable_from_env
 
     maybe_enable_from_env()
-    if _env.world_size > 1 and not jax.distributed.is_initialized():
+    from .jax_compat import distributed_is_initialized
+
+    if _env.world_size > 1 and not distributed_is_initialized():
         coordinator = _env.master or _env.trainer_endpoints[0]
         jax.distributed.initialize(
             coordinator_address=coordinator,

@@ -141,6 +141,17 @@ def enable_compilation_cache(cache_dir: Optional[str] = None) -> str:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # jax initializes its cache singleton lazily at the FIRST compile and
+    # never re-reads the dir config: if anything compiled before this
+    # call (typical in a warm process), the new dir would silently never
+    # be written. Reset so the next compile re-initializes against it.
+    try:
+        from jax._src import compilation_cache as _jcc
+
+        _jcc.reset_cache()
+    except Exception:
+        pass  # no singleton yet (nothing compiled) or API drift — the
+        # config above is then picked up at first initialization anyway
     _enabled_dir = cache_dir
     return cache_dir
 

@@ -67,6 +67,18 @@ define_flag("FLAGS_decode_attention_kernel", False,
             "use the Pallas decode-attention kernel instead of the XLA "
             "batched-matvec path (measured slower at decode shapes on v5e)")
 define_flag("FLAGS_log_level", "INFO", "python log level")
+define_flag("FLAGS_analyze_on_compile",
+            os.environ.get("PADDLE_TPU_ANALYZE_ON_COMPILE", "").lower()
+            in ("1", "true", "yes"),
+            "run the tpucheck jaxpr passes (paddle_tpu.analysis.jaxpr) at "
+            "every first trace of a StaticFunction entry: peak-memory "
+            "liveness, collective/mesh consistency, donation, roofline "
+            "cost. Findings are counted into the metrics registry "
+            "(paddle_tpu_analysis_findings_total{pass,rule}) and "
+            "error/warn findings are logged. Off by default: analysis "
+            "adds one make_jaxpr per compile (~ms at serving shapes, "
+            "more for big train steps); also settable via env "
+            "PADDLE_TPU_ANALYZE_ON_COMPILE=1")
 define_flag("FLAGS_check_tracers",
             os.environ.get("PADDLE_TPU_CHECK_TRACERS", "").lower()
             in ("1", "true", "yes"),

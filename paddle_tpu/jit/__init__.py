@@ -327,6 +327,20 @@ class StaticFunction:
             _cc.record_jit_compile(
                 self._metric_name, sig, time.perf_counter() - t0,
                 retrace=len(self._seen_sigs) > 1)
+            # opt-in tpucheck at first trace (FLAGS_analyze_on_compile):
+            # the compile was just paid, one extra make_jaxpr is noise;
+            # findings land in paddle_tpu_analysis_findings_total and
+            # error/warn ones are warned at the trace site
+            from ..analysis.jaxpr.hook import (analyze_and_record,
+                                               analyze_on_compile_enabled)
+
+            if analyze_on_compile_enabled():
+                if self._is_layer:
+                    hook_args = (state_arrays(self._target), xs, dyn_kw)
+                else:
+                    hook_args = (xs, dyn_kw)
+                analyze_and_record(jitted, hook_args,
+                                   f"{self._metric_name}[{sig[:48]}]")
         return jax.tree_util.tree_map(Tensor._wrap, out)
 
     # parity helpers

@@ -91,8 +91,14 @@ def load_ffi(name: str, sources: Sequence[str], functions: Sequence[str],
     the host even in TPU programs (TPU device code stays Pallas)."""
     import jax
 
+    # jax.ffi graduated from jax.extend.ffi after 0.4.x; same surface
+    try:
+        jax_ffi = jax.ffi
+    except AttributeError:
+        from jax.extend import ffi as jax_ffi
+
     inc = list(load_kwargs.pop("extra_include_paths", []) or [])
-    inc.append(jax.ffi.include_dir())
+    inc.append(jax_ffi.include_dir())
     lib = load(name, sources, extra_include_paths=inc, **load_kwargs)
 
     callers = {}
@@ -112,12 +118,12 @@ def load_ffi(name: str, sources: Sequence[str], functions: Sequence[str],
             target = f"{target}#{n}"
             seen = _ffi_registry.get((target, platform))
         if seen is None:
-            jax.ffi.register_ffi_target(target, jax.ffi.pycapsule(sym),
+            jax_ffi.register_ffi_target(target, jax_ffi.pycapsule(sym),
                                         platform=platform)
             _ffi_registry[(target, platform)] = lib._name
 
         def caller(result_shape_dtypes, *args, _target=target, **attrs):
-            return jax.ffi.ffi_call(_target, result_shape_dtypes)(
+            return jax_ffi.ffi_call(_target, result_shape_dtypes)(
                 *args, **attrs)
 
         callers[fn_name] = caller

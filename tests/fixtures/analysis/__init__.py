@@ -1,0 +1,9 @@
+"""tpucheck golden-report fixtures.
+
+Each module exports ``run() -> AnalysisReport`` — a tiny program with a
+seeded bug (or deliberately clean) for exactly one pass — and has a
+golden JSON twin under ``expected/`` holding the rule IDs the analyzer
+must (and must not) produce. ``tests/test_jaxpr_analysis.py`` asserts
+exact agreement, so every pass provably fires on its bug and stays
+silent on its clean twin.
+"""

@@ -1,0 +1,27 @@
+"""Collective seeded bug: the program was written for a mesh with a
+'model' axis, but the active mesh only defines 'data' — the
+code-not-updated-after-mesh-rename failure. TPC201 (twice: the binder
+mismatch and the psum's axis)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    devs = np.array(jax.devices()[:1])
+    stale_mesh = Mesh(devs.reshape(1), ("model",))
+    active_mesh = Mesh(devs.reshape(1), ("data",))
+
+    def body(x):
+        return jax.lax.psum(x, "model")
+
+    def f(x):
+        return shard_map(body, stale_mesh, in_specs=P(),
+                         out_specs=P())(x)
+
+    x = jnp.ones((4, 8), jnp.float32)
+    return analyze_fn(f, x, mesh=active_mesh)

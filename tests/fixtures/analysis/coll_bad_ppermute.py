@@ -1,0 +1,26 @@
+"""Collective seeded bug: a ppermute whose pairs are not a partial
+permutation — one destination out of the axis range and one source
+duplicated. jax traces it without complaint; TPC203 catches it."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+
+    def body(x):
+        return jax.lax.ppermute(
+            x, "dp", [(0, ndev + 3), (0, 0)])  # out of range + dup source
+
+    def f(x):
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(x)
+
+    x = jnp.ones((ndev * 2, 8), jnp.float32)
+    return analyze_fn(f, x, mesh=mesh)

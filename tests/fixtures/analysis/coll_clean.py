@@ -1,0 +1,30 @@
+"""Collective clean twin: shard_map psum/pmean over axes the active mesh
+binds, plus a (statically-bounded) scan around a psum — scans are NOT a
+divergence hazard and must not trip TPC202."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+
+    def body(x):
+        def scanned(c, xi):
+            return c + jax.lax.psum(xi, "dp"), ()
+
+        tot = jax.lax.pmean(x, "dp")
+        c, _ = jax.lax.scan(scanned, jnp.zeros_like(x[0]), tot)
+        return c
+
+    def f(x):
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P())(x)
+
+    x = jnp.ones((ndev * 4, 8), jnp.float32)
+    return analyze_fn(f, x, mesh=mesh)

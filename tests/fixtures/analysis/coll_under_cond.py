@@ -1,0 +1,30 @@
+"""Collective seeded bug (the acceptance-criteria shape): a psum
+reachable only under a tensor-dependent ``lax.cond`` branch inside a
+shard_map — the canonical multi-host deadlock. TPC202."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+
+    def body(x):
+        pred = jnp.sum(x) > 0.0  # per-shard data → hosts can disagree
+        return jax.lax.cond(
+            pred,
+            lambda v: jax.lax.psum(v, "dp"),   # some ranks enter…
+            lambda v: v,                        # …the rest never do
+            x)
+
+    def f(x):
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(x)
+
+    x = jnp.ones((ndev * 2, 8), jnp.float32)
+    return analyze_fn(f, x, mesh=mesh)

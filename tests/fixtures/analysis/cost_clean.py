@@ -1,0 +1,14 @@
+"""Cost clean twin: the same matmul in bf16 — compute-bound above the
+v5e ridge, no f64, nothing but the liveness advisory."""
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def f(x, w):
+        return jnp.dot(x, w, preferred_element_type=jnp.bfloat16)
+
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+    w = jnp.ones((2048, 2048), jnp.bfloat16)
+    return analyze_fn(f, x, w)

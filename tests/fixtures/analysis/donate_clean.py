@@ -1,0 +1,21 @@
+"""Donation clean twin: params and optimizer state donated, both rebound
+as same-shape outputs AFTER the last read — copy-free aliasing, nothing
+to report (the 4 MiB params are above the TPC302 advisory floor, so the
+silence is meaningful)."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def train_step(params, opt_m, x):
+        g = jax.grad(lambda p: jnp.mean((x @ p) ** 2))(params)
+        new_m = 0.9 * opt_m + g
+        return params - 1e-3 * new_m, new_m
+
+    params = jnp.ones((1024, 1024), jnp.float32)
+    opt_m = jnp.zeros((1024, 1024), jnp.float32)
+    x = jnp.ones((64, 1024), jnp.float32)
+    return analyze_fn(train_step, params, opt_m, x,
+                      donate_argnums=(0, 1))

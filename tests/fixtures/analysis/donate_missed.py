@@ -1,0 +1,17 @@
+"""Donation advisory: a textbook train step whose 4 MiB params die
+before the new params are produced — and nothing is donated. TPC302
+reports the copy-free opportunity and its byte savings."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def train_step(params, x):
+        g = jax.grad(lambda p: jnp.mean((x @ p) ** 2))(params)
+        return params - 1e-3 * g
+
+    params = jnp.ones((1024, 1024), jnp.float32)
+    x = jnp.ones((64, 1024), jnp.float32)
+    return analyze_fn(train_step, params, x)

@@ -1,0 +1,18 @@
+"""Donation seeded bug, second shape: params are donated and a
+same-shape output exists — but the output is produced at the very first
+op while params are still read afterwards, so XLA honors the donation
+with a silent defensive copy. TPC301 (still read)."""
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def step(params, x):
+        doubled = params * 2.0          # alias target, produced first…
+        y = x @ params                  # …but params read again here
+        return doubled, jnp.mean(y)
+
+    params = jnp.ones((1024, 1024), jnp.float32)
+    x = jnp.ones((64, 1024), jnp.float32)
+    return analyze_fn(step, params, x, donate_argnums=(0,))

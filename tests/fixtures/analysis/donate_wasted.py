@@ -1,0 +1,18 @@
+"""Donation seeded bug: the batch is donated but the program's only
+outputs are a scalar loss and an [N] per-example vector — no output
+matches the batch's shape/dtype, so the donation cannot be honored.
+TPC301 (no alias target)."""
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def eval_step(params, x):
+        y = x @ params
+        per_example = jnp.mean(y, axis=-1)
+        return jnp.mean(per_example), per_example
+
+    params = jnp.ones((1024, 512), jnp.float32)
+    x = jnp.ones((256, 1024), jnp.float32)
+    return analyze_fn(eval_step, params, x, donate_argnums=(1,))

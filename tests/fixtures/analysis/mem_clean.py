@@ -1,0 +1,17 @@
+"""Liveness clean twin: a bf16 matmul chain well under budget — no
+TPC101; the TPC102 high-water report names the biggest temp."""
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def f(x, w1, w2):
+        h = jnp.dot(x, w1, preferred_element_type=jnp.bfloat16)
+        h = jnp.maximum(h, 0)
+        return jnp.dot(h, w2, preferred_element_type=jnp.bfloat16)
+
+    x = jnp.ones((1024, 1024), jnp.bfloat16)
+    w1 = jnp.ones((1024, 1024), jnp.bfloat16)
+    w2 = jnp.ones((1024, 1024), jnp.bfloat16)
+    return analyze_fn(f, x, w1, w2, budget_bytes=1 << 30)

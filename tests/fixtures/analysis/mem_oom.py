@@ -1,0 +1,18 @@
+"""Liveness seeded bug: three concurrently-live f32 [4096,4096] temps
+(64 MiB each) against a 32 MiB budget — TPC101 fires before any compile
+would."""
+import jax.numpy as jnp
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+
+
+def run():
+    def f(x, w):
+        a = jnp.dot(x, w)        # 64 MiB, live to the end (returned)
+        b = jnp.dot(a, w)        # 64 MiB
+        c = jnp.dot(b, w)        # 64 MiB
+        return a + c
+
+    x = jnp.ones((4096, 4096), jnp.float32)
+    w = jnp.ones((4096, 4096), jnp.float32)
+    return analyze_fn(f, x, w, budget_bytes=32 << 20)

@@ -1,0 +1,67 @@
+"""tpulint fixture: donation family (TPL304). NOT meant to run.
+
+Source-level shadow of the jaxpr donation pass (TPC301): an argument
+donated to a jitted call no longer belongs to the caller — reading it
+afterwards is a deleted-array RuntimeError on TPU or a silent copy.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def train_step(params, x):
+    new = jax.tree_util.tree_map(lambda p: p - 0.1 * x.sum(), params)
+    return new, x.sum()
+
+
+def bad_reread_after_donation(params, x):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    new_params, loss = step(params, x)
+    norm = jnp.linalg.norm(params["w"])  # EXPECT: TPL304
+    return new_params, loss, norm
+
+
+def bad_inline_donation(params, x):
+    out = jax.jit(train_step, donate_argnums=(0,))(params, x)
+    return out, params  # EXPECT: TPL304
+
+
+def bad_argnames_donation(params, x):
+    step = jax.jit(train_step, donate_argnames=("params",))
+    out = step(params=params, x=x)
+    return out, params["w"]  # EXPECT: TPL304
+
+
+@functools.partial(jax.jit, donate_argnums=(1,))
+def update(x, buf):
+    return buf.at[0].set(x.sum())
+
+
+def bad_call_of_decorated_donator(x, buf):
+    new_buf = update(x, buf)
+    return new_buf + buf  # EXPECT: TPL304
+
+
+def good_rebound_from_results(params, x):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    params, loss = step(params, x)
+    return params, loss  # `params` is the NEW buffer — fine
+
+
+def good_not_donated(params, x):
+    step = jax.jit(train_step)
+    out = step(params, x)
+    return out, params
+
+
+def good_nondonated_position(params, x):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    out = step(params, x)
+    return out, x  # x (position 1) was not donated
+
+
+def suppressed_reread(params, x):
+    step = jax.jit(train_step, donate_argnums=(0,))
+    out = step(params, x)
+    return out, params  # tpulint: disable=TPL304 -- fixture: suppressed on purpose (EXPECT-SUPPRESSED: TPL304)

@@ -100,8 +100,17 @@ def test_microbatch_activations_sharded_over_dp(fleet_dp4_pp2):
         pipeline_engine._debug_inspect_xs = None
     assert captured, "inspect hook never fired"
     # xs is [M=2, mb=8, SEQ, H]; with dp=4 each device must hold mb/4=2 rows
-    shard = captured[0].shard_shape((2, 8, SEQ, H))
-    assert shard[1] == 8 // 4, (shard, captured[0])
+    s = captured[0]
+    if type(s).__name__ == "PositionalSharding":
+        # jax 0.4.x reports a PositionalSharding with trailing size-1 dims
+        # trimmed (here (1, 4, 1) for the 4-D xs) and its shard_shape
+        # cannot rank-promote upward — read the per-dim partition counts
+        # directly: dim 1 must be split dp=4 ways (replicated would be 1)
+        parts = list(s.shape) + [1] * (4 - len(s.shape))
+        assert parts[1] == 4, (parts, s)
+    else:
+        shard = s.shard_shape((2, 8, SEQ, H))
+        assert shard[1] == 8 // 4, (shard, s)
 
 
 def test_per_device_flops_scale_with_dp(fleet_dp4_pp2):
@@ -116,6 +125,9 @@ def test_per_device_flops_scale_with_dp(fleet_dp4_pp2):
             jnp.float32(1e-3), jnp.float32(1), jnp.float32(1.0),
         )
         lowered_cost = lowered.compile().cost_analysis()
+    # jax 0.4.x returns [per-device dict], newer jax the dict itself
+    if isinstance(lowered_cost, (list, tuple)):
+        lowered_cost = lowered_cost[0]
     flops = float(lowered_cost["flops"])
     # analytic total train FLOPs ~ 3 * 2 * N * tokens (fwd + bwd, no remat)
     n_params = sum(int(np.prod(a.shape)) for a in eng._state.values())

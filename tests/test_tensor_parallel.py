@@ -6,7 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from paddle_tpu.distributed.jax_compat import shard_map as compat_shard_map
 
 import paddle_tpu as paddle
 from paddle_tpu import nn
@@ -142,9 +142,9 @@ class TestParallelCrossEntropy:
         logits = rng.standard_normal((B, V)).astype(np.float32)
         labels = rng.integers(0, V, (B,)).astype(np.int32)
 
-        fn = shard_map(
+        fn = compat_shard_map(
             lambda lg, lb: parallel_cross_entropy_shardmap(lg, lb, "mp"),
-            mesh=mesh,
+            mesh,
             in_specs=(P(None, "mp"), P()),
             out_specs=P(),
         )

@@ -98,7 +98,8 @@ class TestRegistry:
     def test_rule_ids_are_stable_and_documented(self):
         assert set(RULES) == {
             "TPL101", "TPL102", "TPL201", "TPL301", "TPL302", "TPL303",
-            "TPL401", "TPL402", "TPL501", "TPL502", "TPL503", "TPL601",
+            "TPL304", "TPL401", "TPL402", "TPL501", "TPL502", "TPL503",
+            "TPL601",
         }
         for r in RULES.values():
             assert r.description and r.name and r.family

@@ -176,9 +176,11 @@ class TestQuantizedModel:
         model = GPTForCausalLM(cfg)
         model.eval()
         p = rng.integers(0, 97, (9,))
-        want = np.asarray(model.generate(
-            Tensor._wrap(jnp.asarray(p[None])), max_new_tokens=8,
-            temperature=0.0))[0, 9:]
+        # fp32 twin for the plausibility replay below (same seed, same
+        # init); `model` is quantized in place next
+        paddle.seed(0)
+        fp32 = GPTForCausalLM(cfg)
+        fp32.eval()
         _, n = quantize_for_decode(model, algo="weight_only_int4")
         assert n == 2 * 4
         assert model.gpt.h[0].attn.qkv_proj.weight_dtype == "int4"
@@ -187,6 +189,22 @@ class TestQuantizedModel:
         r = eng.add_request(p, 8)
         eng.run()
         assert r.done and len(r.tokens) == 8
-        # int4 rounding flips more ties than int8 — ask for weak agreement
-        agree = sum(int(a == b) for a, b in zip(r.tokens, want.tolist()))
-        assert agree >= 3, (r.tokens, want)
+        # "mostly agrees with fp32": raw agreement counting is noise — on
+        # an untrained model the first sub-margin tie flip (int4 rounding
+        # moves logits more than the greedy margins, measured ~3e-3..5e-2
+        # here) sends the two sequences down different prefixes and every
+        # later position is incomparable. The stable property is
+        # plausibility: teacher-forcing the ENGINE's context through the
+        # fp32 model, each engine token must sit in the fp32 top-5 of 97
+        # logits. A broken int4/int8-cache path emits tokens the fp32
+        # model ranks arbitrarily, failing this immediately.
+        ctx = list(p)
+        for i, tok in enumerate(r.tokens):
+            lg = np.asarray(fp32(Tensor._wrap(
+                jnp.asarray(np.asarray(ctx)[None], jnp.int32)))._data[0, -1])
+            rank = int(np.sum(lg > lg[tok]))
+            assert rank < 5, (
+                f"engine token {tok} at step {i} has fp32 rank {rank} "
+                f"(top logits {np.argsort(lg)[-5:][::-1].tolist()}) — "
+                f"int4+int8-cache output is not plausible under fp32")
+            ctx.append(int(tok))

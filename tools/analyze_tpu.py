@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""tpucheck CLI — run the jaxpr analysis passes over the repo's real
+entry points (``make analyze``), or over a chosen subset.
+
+Each registered entry builds a tiny-config version of a real compiled
+path (llama decode, train steps, the quant matmul, the shard_map
+data-parallel step, ...) — small enough to trace in milliseconds under
+``JAX_PLATFORMS=cpu``, structurally identical to the production trace.
+Findings render through the tpulint reporter, one
+``entry:op_index:0: TPCxxx message`` line each, so the output greps like
+``make lint``.
+
+Suppressions are per-entry, declared IN the registry with a written
+justification (mirroring tpulint's ``# tpulint: disable=... -- reason``
+standard): an entry may carry ``suppress={"TPC301": "why"}``. A
+suppression without a justification still fails the gate.
+
+Exit codes: 0 clean, 1 unsuppressed error/warn findings (with
+``--fail-on-violation``), 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+@dataclass
+class Entry:
+    name: str
+    build: Callable  # () -> (fn, args:list, kwargs for analyze_fn)
+    note: str = ""
+    suppress: Dict[str, str] = field(default_factory=dict)
+
+
+# --------------------------------------------------------------- entries
+
+
+def _llama():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor, pause_tape
+    from paddle_tpu.jit import functional_call, state_arrays
+    from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(tiny_llama_config())
+    model.eval()
+    return model, Tensor, pause_tape, functional_call, state_arrays
+
+
+def _llama_decode_step():
+    import jax.numpy as jnp
+
+    model, Tensor, pause_tape, functional_call, state_arrays = _llama()
+    caches = [c._data for c in model.init_caches(2, 64)]
+    state = state_arrays(model)
+    tok = jnp.zeros((2, 1), jnp.int32)
+
+    def llama_decode_step(state, caches, tok, t):
+        with pause_tape():
+            return functional_call(
+                model, state, Tensor._wrap(tok),
+                caches=[Tensor._wrap(c) for c in caches],
+                time_step=Tensor._wrap(t))
+
+    # serving donates the caches (generation scan's donate_argnums=(1,))
+    return llama_decode_step, [state, caches, tok, jnp.int32(5)], {
+        "donate_argnums": (1,)}
+
+
+def _llama_prefill():
+    import jax.numpy as jnp
+
+    model, Tensor, pause_tape, functional_call, state_arrays = _llama()
+    state = state_arrays(model)
+    ids = jnp.zeros((2, 32), jnp.int32)
+
+    def llama_prefill(state, ids):
+        with pause_tape():
+            return functional_call(model, state, Tensor._wrap(ids))
+
+    return llama_prefill, [state, ids], {}
+
+
+def _hapi_train_step():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit import functional_call, param_arrays
+
+    paddle.seed(0)
+    mlp = nn.Sequential(nn.Linear(256, 512), nn.ReLU(),
+                        nn.Linear(512, 256), nn.ReLU(),
+                        nn.Linear(256, 10))
+    params = param_arrays(mlp)
+    x = jnp.ones((64, 256), jnp.float32)
+    y = jnp.zeros((64,), jnp.int32)
+
+    def hapi_train_step(params, x, y):
+        def loss_fn(p):
+            logits = functional_call(mlp, p, Tensor._wrap(x))
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            gold = jnp.take_along_axis(logits, y[:, None], axis=-1)[:, 0]
+            return jnp.mean(logz - gold)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_p = jax.tree_util.tree_map(lambda p, g: p - 1e-3 * g,
+                                       params, grads)
+        return new_p, loss
+
+    return hapi_train_step, [params, x, y], {"donate_argnums": (0,)}
+
+
+def _gpt_train_step():
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.jit import functional_call, param_arrays
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                    max_position=128, vocab_size=512)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    master = param_arrays(model)
+    params = jax.tree_util.tree_map(lambda a: a.astype(jnp.bfloat16), master)
+    opt_m = jax.tree_util.tree_map(lambda a: jnp.zeros_like(a), master)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 64)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 64)), jnp.int32)
+
+    def loss_fn(p, ids, labels):
+        logits = functional_call(model, p, Tensor._wrap(ids))
+        logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+        gold = jnp.take_along_axis(
+            logits, labels[..., None], axis=-1)[..., 0].astype(jnp.float32)
+        return jnp.mean(logz - gold)
+
+    def gpt_train_step(params, master, opt_m, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, ids, labels)
+        grads = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32), grads)
+        new_m = jax.tree_util.tree_map(lambda m, g: 0.9 * m + g,
+                                       opt_m, grads)
+        new_master = jax.tree_util.tree_map(lambda p, m: p - 1e-4 * m,
+                                            master, new_m)
+        new_p = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), new_master)
+        return new_p, new_master, new_m, loss
+
+    return gpt_train_step, [params, master, opt_m, ids, labels], {
+        "donate_argnums": (0, 1, 2)}
+
+
+def _quant_matmul(weight_dtype):
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.framework.tensor import Tensor
+    from paddle_tpu.nn.quant import weight_only_linear
+
+    rng = np.random.default_rng(0)
+    if weight_dtype == "int4":
+        w = jnp.asarray(rng.integers(-8, 7, (256, 1024)), jnp.int8)  # packed
+    else:
+        w = jnp.asarray(rng.integers(-127, 127, (512, 1024)), jnp.int8)
+    sc = jnp.ones((1024,), jnp.float32)
+    x = jnp.ones((4, 512), jnp.float32)
+
+    def quant_matmul(x, w, sc):
+        out = weight_only_linear(Tensor._wrap(x), Tensor._wrap(w),
+                                 weight_scale=Tensor._wrap(sc),
+                                 weight_dtype=weight_dtype)
+        return out._data if isinstance(out, Tensor) else out
+
+    quant_matmul.__name__ = f"quant_matmul_{weight_dtype}"
+    return quant_matmul, [x, w, sc], {}
+
+
+def _dp_psum_step():
+    """The examples/train_bert_dp shape: shard_map data-parallel grad
+    averaging over the 'dp' axis of the active mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.jax_compat import shard_map
+
+    ndev = max(len(jax.devices()), 1)
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    W = jnp.ones((128, 128), jnp.float32)
+    x = jnp.ones((8 * ndev, 128), jnp.float32)
+
+    def step(W, x):
+        def shard_step(W, xs):
+            y = xs @ W
+            loss = jnp.mean(y * y)
+            g = jax.grad(lambda w: jnp.mean((xs @ w) ** 2))(W)
+            g = jax.lax.pmean(g, "dp")
+            return W - 1e-2 * g, loss
+
+        return shard_map(shard_step, mesh,
+                         in_specs=(P(), P("dp", None)),
+                         out_specs=(P(), P()))(W, x)
+
+    dp_psum_step = step
+    return dp_psum_step, [W, x], {"mesh": mesh, "donate_argnums": (0,)}
+
+
+ENTRIES: List[Entry] = [
+    Entry("llama_decode_step", _llama_decode_step,
+          "serving decode: one token through the slab KV cache"),
+    Entry("llama_prefill", _llama_prefill, "serving prefill (flash path)"),
+    Entry("hapi_train_step", _hapi_train_step,
+          "hapi Model-style MLP train step (fwd+bwd+SGD)"),
+    Entry("gpt_train_step", _gpt_train_step,
+          "bench.py train step: bf16 compute, fp32 master, momentum"),
+    Entry("quant_matmul_int8", lambda: _quant_matmul("int8"),
+          "weight-only int8 GEMM (nn.quant XLA path)"),
+    Entry("quant_matmul_int4", lambda: _quant_matmul("int4"),
+          "weight-only packed-int4 GEMM"),
+    Entry("dp_psum_step", _dp_psum_step,
+          "shard_map data-parallel step (collective pass coverage)"),
+]
+
+
+# --------------------------------------------------------------- running
+
+
+def run_entry(entry: Entry, budget_bytes: Optional[int] = None):
+    from paddle_tpu.analysis.jaxpr import analyze_fn
+
+    fn, args, kw = entry.build()
+    kw.setdefault("entry", entry.name)
+    if budget_bytes is not None:
+        kw.setdefault("budget_bytes", budget_bytes)
+    return analyze_fn(fn, *args, **kw)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="analyze_tpu",
+        description="tpucheck — jaxpr-level program analysis over the "
+                    "repo's compiled entry points. Suppress a finding by "
+                    "adding a justified entry-level suppression in the "
+                    "registry (tools/analyze_tpu.py).")
+    ap.add_argument("--entry", action="append", default=None,
+                    help="entry name (repeatable; default: all)")
+    ap.add_argument("--list-entries", action="store_true")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--fail-on-violation", action="store_true",
+                    help="exit 1 on any unsuppressed error/warn finding")
+    ap.add_argument("--show-info", action="store_true",
+                    help="also print advisory (info) findings")
+    ap.add_argument("--budget-gb", type=float, default=None,
+                    help="HBM budget for TPC101, in GiB")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        from paddle_tpu.analysis.jaxpr.rules import JRULES
+
+        fam = None
+        for r in sorted(JRULES.values(), key=lambda r: r.id):
+            if r.family != fam:
+                fam = r.family
+                print(f"\n[{fam}]")
+            print(f"  {r.id}  {r.name} ({r.severity})\n      "
+                  f"{r.description}")
+        return 0
+    if args.list_entries:
+        for e in ENTRIES:
+            print(f"  {e.name:22s} {e.note}")
+        return 0
+
+    chosen = ENTRIES
+    if args.entry:
+        by_name = {e.name: e for e in ENTRIES}
+        missing = [n for n in args.entry if n not in by_name]
+        if missing:
+            print(f"analyze_tpu: unknown entries {missing}; "
+                  f"--list-entries shows the registry", file=sys.stderr)
+            return 2
+        chosen = [by_name[n] for n in args.entry]
+
+    budget = (int(args.budget_gb * (1 << 30))
+              if args.budget_gb is not None else None)
+
+    gating = []        # unsuppressed error/warn
+    suppressed = []    # (finding, reason)
+    infos = []
+    reports = {}
+    for e in chosen:
+        report = run_entry(e, budget)
+        reports[e.name] = report
+        for f in report.findings:
+            if f.severity == "info":
+                infos.append(f)
+            elif f.rule in e.suppress and e.suppress[f.rule].strip():
+                suppressed.append((f, e.suppress[f.rule]))
+            else:
+                gating.append(f)
+
+    if args.format == "json":
+        payload = {
+            "entries": [e.name for e in chosen],
+            "findings": [vars(f.to_violation()) | {
+                "severity": f.severity, "pass": f.passname, "data": f.data}
+                for f in gating],
+            "suppressed": [vars(f.to_violation()) | {"reason": r}
+                           for f, r in suppressed],
+            "info": [vars(f.to_violation()) for f in infos],
+            "memory": {
+                n: {"peak_bytes": r.memory.peak_bytes,
+                    "peak_temp_out_bytes": r.memory.peak_temp_out_bytes}
+                for n, r in reports.items() if r.memory is not None},
+            "cost": {
+                n: {"flops": r.cost.flops, "hbm_bytes": r.cost.hbm_bytes,
+                    "predicted_ms": r.cost.predicted_seconds() * 1e3}
+                for n, r in reports.items() if r.cost is not None},
+        }
+        print(json.dumps(payload, indent=2))
+    else:
+        for f in gating:
+            print(f.to_violation().format())
+        for f, reason in suppressed:
+            v = f.to_violation()
+            v.suppressed, v.suppress_reason = True, reason
+            print(v.format())
+        if args.show_info:
+            for f in infos:
+                print(f.to_violation().format())
+        print(f"tpucheck: {len(chosen)} entries, {len(gating)} finding"
+              f"{'s' if len(gating) != 1 else ''}, {len(suppressed)} "
+              f"suppressed, {len(infos)} advisory")
+
+    if args.fail_on_violation and gating:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -288,6 +288,18 @@ def bench_paged_decode(cfg, on_tpu):
         return {"paged_decode_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_spec(cfg, on_tpu):
+    """Speculative decoding (ISSUE 5): ngram-drafted serving on a
+    repeated-structure workload vs the vanilla engine — accepted
+    tokens/verify-step, acceptance rate, decode_spec_ms_per_token."""
+    try:
+        from paddle_tpu.inference.engine import bench_spec_decode
+
+        return bench_spec_decode(cfg, on_tpu)
+    except Exception as e:
+        return {"spec_decode_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def main():
     from paddle_tpu.framework.compile_cache import enable_compilation_cache
     from paddle_tpu.models.gpt import GPTConfig
@@ -327,6 +339,7 @@ def main():
 
     decode = bench_decode(decode_cfg, on_tpu)
     paged = bench_paged_decode(decode_cfg, on_tpu)
+    spec = bench_spec(decode_cfg, on_tpu)
 
     # observability snapshot (ISSUE 3): the perf trajectory carries the
     # telemetry the run produced — how many programs compiled, whether
@@ -336,6 +349,8 @@ def main():
     from paddle_tpu.observability import histogram_summary, metric_total
 
     tpot = histogram_summary("paddle_serving_tpot_seconds")
+    spec_proposed = metric_total("paddle_tpu_spec_proposed_total")
+    spec_accepted = metric_total("paddle_tpu_spec_accepted_total")
     metrics_block = {
         "compile_count": int(
             metric_total("paddle_jit_compiles_total")
@@ -348,6 +363,14 @@ def main():
             "p50": round(1e3 * tpot.get("p50", 0.0), 3),
             "p99": round(1e3 * tpot.get("p99", 0.0), 3),
         },
+        # spec acceptance as the registry counters saw it (ISSUE 5):
+        # cross-checkable against the bench_spec block's own ratios
+        "spec_proposed": int(spec_proposed),
+        "spec_accepted": int(spec_accepted),
+        "spec_accept_rate": round(
+            spec_accepted / spec_proposed if spec_proposed else 0.0, 3),
+        "decode_spec_ms_per_token": spec.get(
+            "decode_spec_ms_per_token", 0.0),
     }
 
     out = {
@@ -373,6 +396,7 @@ def main():
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         **decode,
         **paged,
+        **spec,
         "metrics": metrics_block,
     }
     print(json.dumps(out))

@@ -35,6 +35,18 @@ def main():
                          "dequant-in-kernel on TPU, XLA convert-fusion "
                          "on CPU) follows FLAGS_weight_only_quant_backend"
                          " — no engine changes needed")
+    ap.add_argument("--spec", choices=["off", "ngram", "draft"],
+                    default="off",
+                    help="speculative decoding (ISSUE 5): 'ngram' drafts "
+                         "by prompt lookup (model-free), 'draft' drafts "
+                         "with a 1-layer llama sharing the vocab; greedy "
+                         "output is identical to --spec off, sampled "
+                         "output stays distribution-exact via rejection "
+                         "sampling")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="max draft tokens per verify step (the verify "
+                         "block scores k+1 positions in one forward); "
+                         "per-request depth adapts to an acceptance EMA")
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve Prometheus text exposition on this port "
                          "(/metrics); 0 picks an ephemeral port, printed "
@@ -79,9 +91,23 @@ def main():
         print(f"weight-only {args.weight_quant}: {swapped} Linears "
               f"swapped, GEMM backend={quant_backend()}")
 
+    draft_model = None
+    if args.spec == "draft":
+        # a deliberately tiny draft: 1 layer, narrow — correctness never
+        # depends on its quality (greedy acceptance is token-exact
+        # against the TARGET), only the accepted tokens/step does
+        dcfg = tiny_llama_config(
+            num_layers=1, hidden_size=32, num_heads=2, num_kv_heads=2,
+            intermediate_size=64, vocab_size=cfg.vocab_size,
+            max_position=cfg.max_position)
+        draft_model = LlamaForCausalLM(dcfg)
+        draft_model.eval()
+
     eng = Engine(model, max_slots=4, num_pages=96, page_size=16,
                  chunk_size=8, dtype=jnp.float32,
-                 quantized_cache=args.int8_cache)
+                 quantized_cache=args.int8_cache,
+                 spec=None if args.spec == "off" else args.spec,
+                 spec_k=args.spec_k, draft_model=draft_model)
     rng = np.random.default_rng(0)
 
     # mixed-length requests, more requests than slots: admission interleaves
@@ -109,6 +135,12 @@ def main():
               f"{len(r.tokens)} tokens (streamed {len(streams[i])})")
     print(f"pool fully recycled: {len(eng._free_pages)}/{free0} free "
           f"(int8_cache={args.int8_cache})")
+    if eng._spec is not None:
+        s = eng._spec.stats()
+        print(f"spec[{s['drafter']}] k={s['k']}: "
+              f"{s['accept_per_step']:.2f} tokens/verify-step, "
+              f"accept rate {s['accept_rate']:.2f}, "
+              f"{s['spec_ms_per_token']:.2f} ms/token")
 
     if args.metrics_jsonl:
         from paddle_tpu.observability import write_jsonl_snapshot

@@ -65,6 +65,16 @@ output. TPU-first design instead of a C++ executor loop:
 * **No head-of-line blocking.** Admission fills any free slot while other
   slots keep decoding; short requests drain and recycle their pages while
   long ones continue.
+* **Speculative decoding (ISSUE 5).** ``Engine(..., spec="ngram"|"draft",
+  spec_k=k)`` swaps the chained decode for drafter→verify scheduling:
+  a pluggable drafter (model-free prompt lookup, or a small draft LM
+  over its own paged pool) proposes up to k tokens, ONE verify forward
+  through the same paged path scores all k+1 positions, and acceptance
+  (token-exact for greedy — output identical to vanilla decode;
+  distribution-preserving rejection sampling for temp>0) lands 1..k+1
+  tokens per step. Rejected rows roll back through ``_trim_pages``;
+  per-request draft depth adapts to an acceptance-rate EMA. See
+  ``paddle_tpu/inference/spec/`` and README "Speculative decoding".
 * **Continuous telemetry (ISSUE 3).** Every scheduling step records the
   vLLM/Orca-style operational surface into the process-global metrics
   registry (``paddle_tpu.observability``): TTFT/TPOT/queue-wait
@@ -201,7 +211,15 @@ class _EngineMetrics:
 
     def on_harvest(self, req: Request, fresh: int):
         """Per-request token-latency accounting; called once per harvest
-        with the number of fresh tokens delivered."""
+        with the number of fresh tokens DELIVERED — never an assumed
+        per-step constant. A vanilla chained step lands k*chunk_size
+        tokens, a spec verify step lands 1..spec_k+1 depending on
+        acceptance (ISSUE 5 satellite): both normalize the harvest span
+        by the accepted count, so the TPOT histogram stays a true
+        per-token latency while acceptance varies. (The chain-depth
+        maximizer's dispatch-cost EMA is likewise acceptance-proof: it
+        only samples pure-decode CHAIN steps — _observe_chain_time —
+        which spec steps never feed.)"""
         now = time.perf_counter()
         if req._t_first is None:
             req._t_first = now
@@ -222,13 +240,16 @@ class Engine:
     def __init__(self, model, max_slots=8, num_pages=512, page_size=16,
                  chunk_size=16, eos_id: Optional[int] = None,
                  dtype=jnp.bfloat16, quantized_cache=False, max_chain=8,
-                 top_k: Optional[int] = None, metrics: bool = True):
+                 top_k: Optional[int] = None, metrics: bool = True,
+                 spec: Optional[str] = None, spec_k: int = 4,
+                 draft_model=None):
         cfg = model.config
         self.model = model
         self.cfg = cfg
         self.max_slots = max_slots
         self.page_size = page_size
         self.chunk_size = chunk_size
+        self.dtype = dtype
         self.max_chain = max(1, int(max_chain))
         if top_k is not None and not 1 <= top_k <= cfg.vocab_size:
             # fail here, not as an opaque trace-time lax.top_k error at
@@ -287,6 +308,16 @@ class Engine:
         self._m = _EngineMetrics() if metrics else None
         if self._m is not None:
             self._m.pages_total.set(num_pages - 1)  # page 0 is trash
+        # speculative decoding (ISSUE 5): spec="ngram" (model-free prompt
+        # lookup) or "draft" (small draft LM, pass draft_model=); the
+        # scheduling loop swaps the chained decode for drafter→verify
+        # steps landing 1..spec_k+1 tokens each — see _spec_step
+        self._spec = None
+        if spec not in (None, "off"):
+            from .spec import SpecDecoder
+
+            self._spec = SpecDecoder(self, mode=spec, k=spec_k,
+                                     draft_model=draft_model)
 
     # ------------------------------------------------------------- requests
     def add_request(self, prompt, max_new_tokens, on_token=None,
@@ -393,18 +424,24 @@ class Engine:
         self.tables[slot, :] = 0
         self.lengths[slot] = 0
         self._free_slots.append(slot)
+        if self._spec is not None:
+            # a draft-model drafter mirrors engine slots in its own page
+            # pool; recycle its side too (no-op for the ngram drafter)
+            self._spec.drafter.release(slot)
 
     # ----------------------------------------------------------- jit bodies
     # Pages travel as a flat list so jit sees ordinary pytrees and donation
     # reuses the (large) page buffers in place. These helpers are PURE with
     # respect to the engine (never mutate self inside a trace).
-    def _states_from(self, pages_flat, tables, lengths, prefill_valid=None):
+    def _states_from(self, pages_flat, tables, lengths, prefill_valid=None,
+                     verify=False):
         L = self.cfg.num_layers
         kp, vp = pages_flat[:L], pages_flat[L:2 * L]
         sc = pages_flat[2 * L:3 * L] if self.quantized else [None] * L
         return [
             PagedCacheState(kp[i], vp[i], sc[i], tables, lengths,
-                            self.page_size, prefill_valid=prefill_valid)
+                            self.page_size, prefill_valid=prefill_valid,
+                            verify=verify)
             for i in range(L)
         ]
 
@@ -668,8 +705,13 @@ class Engine:
                 self._free_slot(slot)
                 req.slot = None
 
-    def _harvest(self, req, toks):
-        """Append generated tokens to a request, honoring eos/max."""
+    def _harvest(self, req, toks) -> int:
+        """Append generated tokens to a request, honoring eos/max. Returns
+        the number of tokens actually CONSUMED — a multi-token append (a
+        decode chain's overshoot, or a spec verify block with an eos or
+        budget edge mid-block) truncates, and the caller needs the real
+        count to roll the slot's KV length/pages back to match (ISSUE 5
+        satellite: eos mid-block must not leave post-eos rows live)."""
         was_done = req.done
         fresh = []
         for t in toks:
@@ -689,6 +731,7 @@ class Engine:
                 self._m.completed.inc()
         if fresh and req.on_token is not None:
             req.on_token(fresh)
+        return len(fresh)
 
     # pre-measurement PRIOR for the cost of a chain boundary (dispatch +
     # blocking fetch) in units of one chunk's compute time. Only seeds
@@ -881,7 +924,11 @@ class Engine:
         the same step), then harvest EVERYTHING with a single blocking
         fetch. One host round trip per step instead of the old two —
         admission never stalls the decode pipeline (VERDICT r4 #2).
+        With speculative decoding enabled the whole iteration is the
+        drafter→verify loop instead (``_spec_step``).
         Returns the number of live requests remaining (queued + active)."""
+        if self._spec is not None:
+            return self._spec_step()
         t0 = time.perf_counter()
         admits, pre_tok, pre_keys = self._admit_dispatch()
         chain = None
@@ -1012,6 +1059,130 @@ class Engine:
                 self.num_pages - 1 - len(self._free_pages))
         return len(self._queue) + len(self._active)
 
+    # ------------------------------------------------ speculative decoding
+    def _spec_step(self) -> int:
+        """One spec-decode scheduling iteration (ISSUE 5 tentpole):
+        admit (blocking — drafting needs the host-side token history of
+        every active request anyway), let the drafter propose up to k
+        tokens per request, score ALL k+1 positions in ONE verify
+        forward through the paged decode path, then accept — token-exact
+        prefix matching for greedy, distribution-preserving rejection
+        sampling for temperature>0 (reusing the per-request key state) —
+        and roll rejected rows back via ``_trim_pages`` so the
+        preemption/eviction invariants hold. Each step lands 1..k+1
+        tokens per request; every metric normalizes by the ACTUAL count
+        (see ``_EngineMetrics.on_harvest``), and spec steps never feed
+        ``_observe_chain_time`` — the chain-depth calibration stays a
+        vanilla-only fit that varying acceptance cannot skew."""
+        t0 = time.perf_counter()
+        spec = self._spec
+        self._admit()
+        if not self._active:
+            if self._queue:
+                raise RuntimeError(
+                    "scheduler stalled: queued requests but nothing active "
+                    "and no admission possible (page pool too "
+                    "fragmented/small)")
+            if self._m is not None:
+                self._m.active_slots.set(0)
+                self._m.queue_depth.set(len(self._queue))
+            return len(self._queue)
+        k = spec.k
+        # allocate the k+1-row verify block for every slot, preempting
+        # the longest request under pool pressure exactly like the
+        # vanilla depth-1 chain (writes past a request's own budget cap
+        # route to the trash page via the zero table entries)
+        while True:
+            ok = True
+            for slot in sorted(self._active,
+                               key=lambda s: -int(self.lengths[s])):
+                req = self._active[slot]
+                limit = req.prompt.size + req.max_new_tokens + 1
+                target = min(int(self.lengths[slot]) + k + 1, limit)
+                if not self._ensure_pages(slot, target):
+                    ok = False
+                    break
+            if ok:
+                break
+            for slot in self._active:
+                self._trim_pages(slot, int(self.lengths[slot]))
+            victims = sorted(self._active,
+                             key=lambda s: -int(self.lengths[s]))
+            if len(victims) <= 1:
+                raise RuntimeError(
+                    "KV page pool exhausted even after preemption; the "
+                    "add_request capacity check should prevent this")
+            self._preempt(victims[0])
+        slots = sorted(self._active)
+        reqs = [self._active[s] for s in slots]
+        n = len(slots)
+        nb = _pow2ceil(n)
+        want = [spec.controller.draft_len(r) for r in reqs]
+        drafts, dlen = spec.drafter.propose(self, slots, reqs, want, k)
+        tables_c = np.zeros((nb, self.max_pages_per_seq), np.int32)
+        lengths_c = np.zeros((nb,), np.int32)
+        last_c = np.zeros((nb,), np.int32)
+        temps_c = np.zeros((nb,), np.float32)
+        keys_c = np.zeros((nb, 2), np.uint32)
+        dlen_c = np.zeros((nb,), np.int32)
+        tables_c[:n] = self.tables[slots]
+        lengths_c[:n] = self.lengths[slots]
+        last_c[:n] = self._last_tok[slots]
+        temps_c[:n] = self._temps[slots]
+        keys_c[:n] = self._keys[slots]
+        dlen_c[:n] = dlen
+        sampling = bool(np.any(temps_c > 0.0))
+        verify = spec.get_verify(nb, sampling)
+        if self._m is not None:
+            self._m.decode_batch.observe(n)
+        # ONE dispatch scores every draft position; the fetch below is
+        # the step's only blocking sync besides admission
+        toks_d, nem_d, len_d, keys_d, pages = verify(
+            self._params, self._pages_flat(), jnp.asarray(tables_c),
+            jnp.asarray(lengths_c), jnp.asarray(last_c),
+            jnp.asarray(drafts), jnp.asarray(dlen_c),
+            jnp.asarray(temps_c), jnp.asarray(keys_c))
+        self._set_pages(pages)
+        toks, nem, lengths_h, keys_h = (
+            np.asarray(a) for a in jax.device_get(
+                (toks_d, nem_d, len_d, keys_d)))
+        landed = 0
+        for i, (slot, req) in enumerate(zip(slots, reqs)):
+            n_emit = int(nem[i])
+            accepted = n_emit - 1  # drafts accepted (bonus token is free)
+            consumed = self._harvest(req, toks[i, :n_emit].tolist())
+            landed += consumed
+            spec.note(req, proposed=int(dlen[i]), accepted=accepted,
+                      landed=consumed)
+            if req.done:
+                # eos/budget mid-block: _harvest truncated the accepted
+                # block at the boundary; freeing the slot recycles every
+                # page — INCLUDING rows past the eos — the same step
+                # (ISSUE 5 satellite)
+                del self._active[slot]
+                self._free_slot(slot)
+                req.slot = None
+                spec.drafter.release(slot)
+                spec.controller.forget(req)
+            else:
+                # keep exactly the accepted prefix: lengths rolls back to
+                # base + 1 + accepted (computed in-program) and the
+                # headroom pages — rejected draft rows included — return
+                # to the pool
+                self.lengths[slot] = int(lengths_h[i])
+                self._last_tok[slot] = int(toks[i, n_emit - 1])
+                self._keys[slot] = keys_h[i]
+                self._trim_pages(slot, int(lengths_h[i]))
+        wall = time.perf_counter() - t0
+        spec.observe_step(wall)
+        if self._m is not None:
+            self._m.step_seconds.observe(wall)
+            self._m.active_slots.set(len(self._active))
+            self._m.queue_depth.set(len(self._queue))
+            self._m.pages_in_use.set(
+                self.num_pages - 1 - len(self._free_pages))
+        return len(self._queue) + len(self._active)
+
     def run(self, requests=None) -> List[Request]:
         """Serve ``requests`` (or whatever is queued) to completion."""
         if requests:
@@ -1129,4 +1300,58 @@ def bench_engine_decode(cfg, on_tpu):
             rates.append(sum(len(r.tokens) for r in reqs) / dt)
         out[f"{key}_serve_tokens_per_sec"] = round(
             sorted(rates)[len(rates) // 2], 1)
+    return out
+
+
+def bench_spec_decode(cfg, on_tpu):
+    """Speculative decoding on a repeated-structure workload (ISSUE 5):
+    prompts tile a short motif, and greedy continuations of a small model
+    collapse into repetition — the regime prompt-lookup drafting exploits
+    (templated text, code, copied spans in real serving). Reports mean
+    accepted tokens per verify step, draft acceptance rate, and measured
+    spec ms/token beside the vanilla engine on the SAME workload and
+    geometry (the acceptance criterion: ngram accept/step >= 1.5)."""
+    from ..models.gpt import GPTForCausalLM
+
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    slots = 4 if on_tpu else 2
+    new_tokens = 128 if on_tpu else 48
+    spec_k = 8
+
+    def workload(eng):
+        reqs = []
+        r = np.random.default_rng(23)
+        for _ in range(2 * slots):
+            motif = r.integers(0, cfg.vocab_size, (8,))
+            reqs.append(eng.add_request(np.tile(motif, 4), new_tokens))
+        return reqs
+
+    out = {}
+    for mode in (None, "ngram"):
+        eng = Engine(model, max_slots=slots,
+                     num_pages=(slots + 2) * cfg.max_position // 16 + 1,
+                     page_size=16, chunk_size=8,
+                     max_chain=8 if on_tpu else 2,
+                     spec=mode, spec_k=spec_k)
+        for _ in range(2):  # warm every compiled bucket
+            workload(eng)
+            eng.run()
+        reqs = workload(eng)
+        t0 = time.perf_counter()
+        eng.run()
+        dt = time.perf_counter() - t0
+        total = sum(len(r.tokens) for r in reqs)
+        key = "vanilla" if mode is None else f"spec_{mode}"
+        out[f"{key}_serve_tokens_per_sec"] = round(total / dt, 1)
+        if mode is not None:
+            stats = eng._spec.stats()
+            out[f"spec_{mode}_accept_per_step"] = round(
+                stats["accept_per_step"], 3)
+            out[f"spec_{mode}_accept_rate"] = round(
+                stats["accept_rate"], 3)
+            out["decode_spec_ms_per_token"] = round(
+                stats["spec_ms_per_token"], 3)
+            out["spec_k"] = stats["k"]
     return out

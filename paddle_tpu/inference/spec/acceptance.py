@@ -1,0 +1,116 @@
+"""Draft-token acceptance for speculative decoding (ISSUE 5).
+
+Traced device code: runs INSIDE the engine's compiled verify program, so
+the whole accept/resample decision costs zero extra host round trips and
+the per-request PRNG key state threads through exactly like the vanilla
+``Engine._select_token`` path (keys survive preemption with the request).
+
+Semantics (Leviathan et al. 2023, specialized to point-mass proposals —
+both shipped drafters propose deterministic tokens, so q(x) = δ_d):
+
+* **Greedy rows** (``temperature == 0``): accept the longest prefix of
+  drafts that token-exactly matches the target argmax chain, then emit
+  the argmax at the first mismatch (the "correction" token). The emitted
+  stream is the vanilla greedy chain BY CONSTRUCTION — drafter quality
+  only changes how many tokens land per step, never which tokens. Key
+  state is untouched (greedy requests stay key-independent, matching
+  ``_select_token``).
+* **Sampled rows** (``temperature > 0``): accept draft ``d`` at position
+  ``j`` with probability ``p_j(d)`` (= min(1, p/q) for q = δ_d); on the
+  first rejection sample from the residual ``norm(max(p - q, 0))`` — p
+  with the rejected token removed and renormalized. If every draft is
+  accepted (or none was proposed), the bonus token samples from p
+  directly. This preserves the target distribution exactly, position by
+  position — the distribution test in tests/test_spec_decode.py checks
+  the emitted-token marginal against target softmax empirically.
+
+Top-k filtering and temperature scaling replicate ``_select_token``'s
+order (filter raw logits, then scale), so spec and vanilla sampling draw
+from identical per-position distributions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["accept_tokens"]
+
+
+def accept_tokens(logits, drafts, draft_len, temps, keys, top_k=None,
+                  sampling=True):
+    """Score a verify block and pick the accepted tokens.
+
+    logits    [B, k+1, V] f32 — target logits at the k+1 verify positions
+              (position j conditions on the context through input row j)
+    drafts    [B, k] i32     — proposed draft tokens
+    draft_len [B] i32        — valid drafts per row (rest is padding)
+    temps     [B] f32        — 0 = greedy
+    keys      [B, 2] u32     — live per-request PRNG keys
+    sampling  static         — False compiles the greedy-only program
+                               without any RNG machinery (the common
+                               serving case, mirroring ``_get_decode``)
+
+    Returns ``(toks [B, k+1] i32, n_emit [B] i32, new_keys [B, 2])``:
+    ``toks[b, :n_emit[b]]`` is the accepted draft prefix followed by one
+    bonus/correction token; key state only burns for sampled rows.
+    """
+    b, m, v = logits.shape
+    k = m - 1
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1]
+        logits = jnp.where(logits >= kth[..., None], logits, -jnp.inf)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, m]
+    j = jnp.arange(k, dtype=jnp.int32)[None]
+    valid = j < draft_len[:, None]  # [B, k]
+    accept_greedy = valid & (drafts == greedy[:, :k])
+
+    if not sampling:
+        accept = accept_greedy
+        new_keys = keys
+        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1)
+        bonus = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)[:, 0]
+    else:
+        scaled = logits / jnp.maximum(temps, 1e-6)[:, None, None]
+        probs = jax.nn.softmax(scaled, axis=-1)  # [B, m, V]
+        # burn k+2 subkeys per row: k acceptance uniforms, 1 categorical
+        # for the bonus/residual draw, 1 carried key — a FIXED schedule
+        # (independent of draft_len/acceptance), so a request's key
+        # stream depends only on how many verify steps it has lived
+        # through, never on batch composition
+        splits = jax.vmap(lambda key: jax.random.split(key, k + 2))(keys)
+        new_keys = splits[:, 0]
+        u = jax.vmap(lambda ks: jax.vmap(jax.random.uniform)(ks))(
+            splits[:, 1:k + 1])  # [B, k] in [0, 1)
+        p_draft = jnp.take_along_axis(
+            probs[:, :k], drafts[..., None], axis=-1)[..., 0]  # [B, k]
+        accept_sampled = valid & (u < p_draft)
+        accept = jnp.where((temps > 0.0)[:, None], accept_sampled,
+                           accept_greedy)
+        n_acc = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1),
+                        axis=1)  # [B] in [0, k]
+        # bonus token from position n_acc: residual (rejected draft
+        # removed) when a proposal was rejected there, plain target
+        # sampling when drafts simply ran out
+        final_scaled = jnp.take_along_axis(
+            scaled, n_acc[:, None, None], axis=1)[:, 0]  # [B, V]
+        rejected = n_acc < draft_len
+        rej_tok = jnp.take_along_axis(
+            drafts, jnp.clip(n_acc, 0, k - 1)[:, None], axis=1)[:, 0]
+        drop = ((jnp.arange(v, dtype=jnp.int32)[None] == rej_tok[:, None])
+                & rejected[:, None])
+        final_scaled = jnp.where(drop, -jnp.inf, final_scaled)
+        sampled_bonus = jax.vmap(jax.random.categorical)(
+            splits[:, k + 1], final_scaled).astype(jnp.int32)
+        final_greedy = jnp.take_along_axis(
+            greedy, n_acc[:, None], axis=1)[:, 0]
+        bonus = jnp.where(temps > 0.0, sampled_bonus, final_greedy)
+        new_keys = jnp.where((temps > 0.0)[:, None], new_keys, keys)
+
+    # assemble [accepted draft prefix, bonus, 0 padding]
+    pos = jnp.arange(m, dtype=jnp.int32)[None]
+    draft_pad = jnp.concatenate(
+        [drafts, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    toks = jnp.where(pos < n_acc[:, None], draft_pad,
+                     jnp.where(pos == n_acc[:, None], bonus[:, None], 0))
+    return toks.astype(jnp.int32), (n_acc + 1).astype(jnp.int32), new_keys

@@ -1,0 +1,54 @@
+"""Per-request adaptive draft length (ISSUE 5 tentpole, part 4).
+
+Drafting is a bet: a verify block burns target-model compute on every
+proposed position whether or not it lands. A request whose recent drafts
+keep getting rejected (unpredictable continuation) should shrink its bet
+toward 1; a request riding a predictable stretch (repetition, template,
+copied span) should raise it back toward ``k_max``. The controller keeps
+one acceptance-rate EMA per request — NOT per engine — because mixed
+workloads routinely contain both regimes at once.
+
+The draft width of the COMPILED verify program stays the static bucket
+``k_max`` (one program, no recompiles as rates drift); adaptation only
+changes how many of the k slots carry real proposals (``draft_len``),
+which is a traced operand.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+__all__ = ["AdaptiveDraftController"]
+
+
+class AdaptiveDraftController:
+    def __init__(self, k_max: int, alpha: float = 0.4):
+        self.k_max = max(1, int(k_max))
+        self.alpha = float(alpha)
+        self._ema: Dict[int, float] = {}  # rid -> acceptance-rate EMA
+
+    def draft_len(self, req) -> int:
+        """Drafts to propose for ``req`` this verify step."""
+        remaining = req.max_new_tokens - len(req.tokens)
+        if remaining <= 1:
+            return 0  # the bonus token finishes the request; drafts waste
+        # optimistic start (probe the full width), then track the EMA;
+        # never below 1 — a zero-draft steady state could never observe
+        # the acceptance recovering
+        ema = self._ema.get(req.rid, 1.0)
+        want = int(ema * self.k_max + 0.5)
+        return max(1, min(self.k_max, want, remaining - 1))
+
+    def update(self, req, proposed: int, accepted: int):
+        if proposed <= 0:
+            return
+        rate = min(accepted, proposed) / proposed
+        prev = self._ema.get(req.rid)
+        self._ema[req.rid] = (rate if prev is None
+                              else (1 - self.alpha) * prev
+                              + self.alpha * rate)
+
+    def rate(self, req) -> float:
+        return self._ema.get(req.rid, 1.0)
+
+    def forget(self, req):
+        self._ema.pop(req.rid, None)

@@ -1,0 +1,66 @@
+"""The batched spec-decode verifier (ISSUE 5 tentpole, part 2).
+
+One compiled program scores ALL k draft positions in ONE forward through
+the existing paged decode path: the input row is ``[last_tok, d1..dk]``,
+the ``PagedCacheState(verify=True)`` flag routes every attention layer
+through ``paged_state_verify`` (append k+1 rows at [len, len+k+1), attend
+each position over cache + causal prefix), and acceptance runs in the
+same program — so a verify step costs exactly one dispatch + one fetch,
+like a vanilla decode chunk.
+
+Cache rollback happens INSIDE the program: the returned ``new_lengths``
+is ``len + 1 + n_accepted`` (the accepted prefix), not ``len + k + 1``
+(what was physically written). Rejected rows become dead data past
+``lengths`` — the same data-only-exists-up-to-``lengths`` invariant the
+engine's trash page relies on — and the host returns their headroom
+pages via ``Engine._trim_pages`` at harvest.
+
+``make_verify_fn`` returns the UNJITTED python function (the engine
+wraps it with ``jax.jit(donate_argnums=(1,))`` so the page buffers reuse
+in place); the tpucheck registry (``tools/analyze_tpu.py`` entry
+``spec_verify_step``) traces the same raw function, so ``make analyze``
+sweeps the real serving program for liveness/collective/donation/cost
+findings.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .acceptance import accept_tokens
+
+__all__ = ["make_verify_fn"]
+
+
+def make_verify_fn(engine, sampling):
+    """Build the raw verify step for ``engine``. Shapes (batch bucket nb,
+    draft width k) are inferred from the arguments, so one function per
+    ``sampling`` flag serves every (nb, k) jit specialization."""
+    model = engine.model
+
+    def spec_verify_step(params, pages_flat, tables, lengths, last_tok,
+                         drafts, draft_len, temps, keys):
+        from ...framework.tensor import Tensor, pause_tape
+        from ...jit import swapped_tensors
+
+        with swapped_tensors(engine._swap, params), pause_tape():
+            ids = jnp.concatenate([last_tok[:, None], drafts], axis=1)
+            states = engine._states_from(pages_flat, tables, lengths,
+                                         verify=True)
+            logits, new_states = model.forward(Tensor._wrap(ids),
+                                               caches=states)
+            lg = (logits._data if isinstance(logits, Tensor)
+                  else logits).astype(jnp.float32)
+            toks, n_emit, new_keys = accept_tokens(
+                lg, drafts, draft_len, temps, keys,
+                top_k=engine.top_k, sampling=sampling)
+            # roll back to the accepted prefix: base + (last_tok + accepted
+            # drafts) rows are live, rejected rows are dead data the next
+            # append overwrites. Idle/pad rows (length 0) stay 0.
+            active = lengths > 0
+            cap = tables.shape[1] * engine.page_size
+            new_lengths = jnp.where(
+                active, jnp.minimum(lengths + n_emit, cap), lengths)
+            return (toks, n_emit, new_lengths, new_keys,
+                    engine._pages_of(new_states))
+
+    return spec_verify_step

@@ -549,7 +549,7 @@ class PagedCacheState:
     """
 
     def __init__(self, k_pages, v_pages, scale_pages, block_tables,
-                 lengths, page_size, prefill_valid=None):
+                 lengths, page_size, prefill_valid=None, verify=False):
         self.k_pages = k_pages
         self.v_pages = v_pages
         self.scale_pages = scale_pages    # [P, ps, 128] bf16 or None
@@ -559,6 +559,10 @@ class PagedCacheState:
         # [B] int32 valid widths of a padded prompt during prefill (None →
         # the whole width is valid); models keep passing time_step=None
         self.prefill_valid = prefill_valid
+        # static flag: a multi-token forward over this state is a spec-
+        # decode VERIFY (append s tokens at [len, len+s) and attend each
+        # over cache + causal prefix), not a prefill — see paged_forward
+        self.verify = bool(verify)
 
     @property
     def quantized(self):
@@ -582,17 +586,19 @@ class PagedCacheState:
     def tree_flatten(self):
         return ((self.k_pages, self.v_pages, self.scale_pages,
                  self.block_tables, self.lengths, self.prefill_valid),
-                self.page_size)
+                (self.page_size, self.verify))
 
     @classmethod
-    def tree_unflatten(cls, page_size, children):
-        return cls(*children[:5], page_size, prefill_valid=children[5])
+    def tree_unflatten(cls, aux, children):
+        page_size, verify = aux
+        return cls(*children[:5], page_size, prefill_valid=children[5],
+                   verify=verify)
 
     def replace(self, **kw):
         fields = dict(k_pages=self.k_pages, v_pages=self.v_pages,
                       scale_pages=self.scale_pages,
                       block_tables=self.block_tables, lengths=self.lengths,
-                      prefill_valid=self.prefill_valid)
+                      prefill_valid=self.prefill_valid, verify=self.verify)
         fields.update(kw)
         return PagedCacheState(page_size=self.page_size, **fields)
 
@@ -673,6 +679,97 @@ def paged_state_step(state, q, k, v, scale=None):
     return out.astype(q.dtype), state
 
 
+def _paged_multi_query_ref(q, state, base_len, scale=None):
+    """Multi-position paged attention: query j of slot b attends over the
+    cache window tokens ``< base_len[b] + j + 1`` — the cached context plus
+    the causal prefix of the freshly written verify block. q [B, m, H, D]
+    against slab pages; returns [B, m, H, D] f32.
+
+    jnp window-gather implementation (the exact twin family of
+    ``_paged_slab_ref``): materializes each slot's padded window once and
+    masks per position. Runs through XLA on every backend — for small m
+    (spec-decode verify blocks, m = k+1 ≤ chunk_size) the gather is the
+    same bytes the slab decode kernel moves per step, amortized over m
+    positions; a fused Pallas slab-verify kernel is the on-chip follow-up.
+    """
+    b, m, h, d = q.shape
+    p_total, page_size, khd = state.k_pages.shape
+    h_kv = khd // d
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    bt = jnp.asarray(state.block_tables, jnp.int32)
+    max_pages = bt.shape[1]
+    seq = max_pages * page_size
+
+    def window(pages, sc):
+        win = pages[bt].astype(jnp.float32)  # [B, max_pages, ps, KHD]
+        win = win.reshape(b, seq, h_kv, d)
+        if sc is not None:
+            win = win * sc.astype(jnp.float32)[..., None]
+        return win  # [B, S, Hkv, D]
+
+    ks = vs = None
+    if state.quantized:
+        scw = state.scale_pages[bt].reshape(b, seq, 128)
+        ks, vs = scw[..., :h_kv], scw[..., h_kv:2 * h_kv]
+    k_c = window(state.k_pages, ks)
+    v_c = window(state.v_pages, vs)
+    if h_kv != h:
+        rep = h // h_kv
+        k_c = jnp.repeat(k_c, rep, axis=2)
+        v_c = jnp.repeat(v_c, rep, axis=2)
+    s = jnp.einsum("bmhd,bshd->bmhs", q.astype(jnp.float32), k_c) * scale
+    # causal per-position limits, clamped at the table capacity so an
+    # overshooting verify block (positions saturated at cap-1) still
+    # masks consistently with what was actually written
+    limit = jnp.minimum(
+        base_len[:, None] + jnp.arange(m, dtype=jnp.int32)[None] + 1, seq)
+    mask = (jnp.arange(seq, dtype=jnp.int32)[None, None]
+            < limit[..., None])  # [B, m, S]
+    s = jnp.where(mask[:, :, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bmhs,bshd->bmhd", p, v_c)
+
+
+def paged_state_verify(state, q, k, v, scale=None):
+    """Speculative-decoding verify step: append ``m`` tokens per active
+    slot at positions [len, len+m) and score EVERY position in one pass.
+    q [B, m, H, D], k/v [B, m, Hkv, D] → (out [B, m, H, D], new state with
+    ``lengths += m``).
+
+    The caller (the engine's verify program) decides post-hoc how many of
+    the m freshly written rows to KEEP: it rolls ``lengths`` back to the
+    accepted prefix (rejected rows become dead data past ``lengths`` that
+    the next append overwrites — the same data-only-exists-up-to-lengths
+    invariant the trash page relies on) and returns the headroom pages via
+    ``Engine._trim_pages``. Idle slots (length 0) write to the trash page
+    and read garbage the engine discards, exactly like the decode step."""
+    b, m = q.shape[:2]
+    base = state.lengths
+    active = base > 0
+    pos = state.positions(m)  # [B, m], clamped at capacity - 1
+    valid = jnp.broadcast_to(active[:, None], (b, m))
+    logical = jnp.clip(pos // state.page_size, 0,
+                       state.block_tables.shape[1] - 1)
+    phys = jnp.where(valid,
+                     jnp.take_along_axis(state.block_tables, logical, axis=1),
+                     0)
+    slotpos = jnp.where(valid, pos % state.page_size, 0)
+    kq, vq, sc = _store_rows(state, k, v)  # [B, m, KHD]
+    cap = state.block_tables.shape[1] * state.page_size
+    new = dict(
+        k_pages=state.k_pages.at[phys, slotpos].set(kq),
+        v_pages=state.v_pages.at[phys, slotpos].set(vq),
+        lengths=jnp.minimum(
+            base + m * active.astype(state.lengths.dtype), cap),
+    )
+    if state.quantized:
+        new["scale_pages"] = state.scale_pages.at[phys, slotpos].set(sc)
+    state = state.replace(**new)
+    out = _paged_multi_query_ref(q, state, base, scale=scale)
+    return out.astype(q.dtype), state
+
+
 def paged_forward(cache: "PagedKVCache", q, k, v, time_step,
                   context_attention):
     """Shared model-side paged-cache step (one copy for every attention
@@ -695,6 +792,12 @@ def paged_forward(cache: "PagedKVCache", q, k, v, time_step,
     ``(out, cache)`` (the host-managed cache returns itself)."""
     q, k, v = (getattr(t, "_data", t) for t in (q, k, v))
     if isinstance(cache, PagedCacheState):
+        # spec-decode verify (static flag, checked FIRST: a verify block
+        # is multi-token and would otherwise mis-route to prefill, whose
+        # context_attention ignores the cached prefix)
+        if cache.verify:
+            out, new_state = paged_state_verify(cache, q, k, v)
+            return out, new_state
         # prefill when the state carries prefill_valid (the engine sets it
         # for every admission — including single-token prompts, which the
         # old s > 1 heuristic mis-routed to the decode path) or when the

@@ -224,6 +224,38 @@ def _dp_psum_step():
     return dp_psum_step, [W, x], {"mesh": mesh, "donate_argnums": (0,)}
 
 
+def _spec_verify_step():
+    """The spec-decode verify program (ISSUE 5): k+1 positions scored in
+    one forward through the paged path + in-program acceptance, traced
+    exactly as the engine jits it (pages donated)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import Engine
+    from paddle_tpu.inference.spec.verifier import make_verify_fn
+    from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(tiny_llama_config())
+    model.eval()
+    eng = Engine(model, max_slots=2, num_pages=32, page_size=8,
+                 chunk_size=4, dtype=jnp.float32, spec="ngram", spec_k=4)
+    nb, k = 2, 4
+    fn = make_verify_fn(eng, sampling=False)
+    fn.__name__ = "spec_verify_step"
+    tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+    tables[:, :2] = [[1, 2], [3, 4]]
+    args = [eng._params, eng._pages_flat(), jnp.asarray(tables),
+            jnp.asarray(np.array([9, 6], np.int32)),       # lengths
+            jnp.zeros((nb,), jnp.int32),                   # last_tok
+            jnp.zeros((nb, k), jnp.int32),                 # drafts
+            jnp.full((nb,), k, jnp.int32),                 # draft_len
+            jnp.zeros((nb,), jnp.float32),                 # temps
+            jnp.zeros((nb, 2), jnp.uint32)]                # keys
+    return fn, args, {"donate_argnums": (1,)}
+
+
 ENTRIES: List[Entry] = [
     Entry("llama_decode_step", _llama_decode_step,
           "serving decode: one token through the slab KV cache"),
@@ -238,6 +270,8 @@ ENTRIES: List[Entry] = [
           "weight-only packed-int4 GEMM"),
     Entry("dp_psum_step", _dp_psum_step,
           "shard_map data-parallel step (collective pass coverage)"),
+    Entry("spec_verify_step", _spec_verify_step,
+          "spec-decode verify: k+1 positions + acceptance, paged path"),
 ]
 
 

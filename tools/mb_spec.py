@@ -1,0 +1,110 @@
+"""Speculative-decoding microbench (ISSUE 5): drafter x k x batch sweep
+over the repeated-structure workload — accepted tokens per verify step
+and measured ms/token per configuration, one JSON line each appended to
+tools/mb_results.jsonl (the mb_flash/mb_quant/mb_metrics convention).
+
+Usage: python tools/mb_spec.py [TAG]
+
+The workload tiles a short random motif per prompt; on the untrained
+tiny model greedy continuations collapse into repetition, which is the
+regime prompt-lookup drafting exploits (and the deliberately weak
+1-layer draft model mostly fails at — its line is the floor: spec
+machinery with ~0 acceptance still lands 1 token per verify step and
+shows the verify block's overhead).
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+from paddle_tpu.framework.compile_cache import enable_compilation_cache
+
+enable_compilation_cache()
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.inference.engine import Engine  # noqa: E402
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM  # noqa: E402
+
+
+def make_models(on_tpu):
+    paddle.seed(0)
+    cfg = (GPTConfig(hidden_size=768, num_layers=12, num_heads=12,
+                     max_position=1024, vocab_size=50304) if on_tpu else
+           GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                     max_position=256, vocab_size=1024))
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    dcfg = GPTConfig(hidden_size=cfg.hidden_size // 4, num_layers=1,
+                     num_heads=2, max_position=cfg.max_position,
+                     vocab_size=cfg.vocab_size)
+    draft = GPTForCausalLM(dcfg)
+    draft.eval()
+    draft.bfloat16()
+    return cfg, model, draft
+
+
+def run_config(cfg, model, draft, drafter, k, slots, new_tokens, on_tpu):
+    eng = Engine(model, max_slots=slots,
+                 num_pages=(slots + 2) * cfg.max_position // 16 + 1,
+                 page_size=16, chunk_size=max(8, k), spec=drafter,
+                 spec_k=k,
+                 draft_model=draft if drafter == "draft" else None)
+
+    def workload():
+        r = np.random.default_rng(23)
+        return [eng.add_request(
+            np.tile(r.integers(0, cfg.vocab_size, (8,)), 4), new_tokens)
+            for _ in range(2 * slots)]
+
+    workload()
+    eng.run()  # warm every compiled bucket
+    base_steps = eng._spec.request_steps
+    base_tokens = eng._spec.tokens_landed
+    base_prop = eng._spec.drafts_proposed
+    base_acc = eng._spec.drafts_accepted
+    reqs = workload()
+    t0 = time.perf_counter()
+    eng.run()
+    dt = time.perf_counter() - t0
+    total = sum(len(r.tokens) for r in reqs)
+    steps = eng._spec.request_steps - base_steps
+    landed = eng._spec.tokens_landed - base_tokens
+    prop = eng._spec.drafts_proposed - base_prop
+    acc = eng._spec.drafts_accepted - base_acc
+    return {
+        "accept_per_step": round(landed / steps if steps else 0.0, 3),
+        "accept_rate": round(acc / prop if prop else 0.0, 3),
+        "ms_per_token": round(1e3 * dt / total, 3),
+        "tokens_per_sec": round(total / dt, 1),
+    }
+
+
+def main():
+    tag = sys.argv[1] if len(sys.argv) > 1 else "spec"
+    on_tpu = jax.default_backend() == "tpu"
+    cfg, model, draft = make_models(on_tpu)
+    device = getattr(jax.devices()[0], "device_kind", "cpu")
+    new_tokens = 128 if on_tpu else 32
+    lines = []
+    for drafter in ("ngram", "draft"):
+        for k in (2, 4, 8):
+            for slots in (1, 2) if not on_tpu else (2, 8):
+                r = run_config(cfg, model, draft, drafter, k, slots,
+                               new_tokens, on_tpu)
+                line = {"tag": tag, "bench": "spec_decode",
+                        "drafter": drafter, "k": k, "slots": slots,
+                        "new_tokens": new_tokens, "device": device, **r}
+                lines.append(line)
+                print(json.dumps(line))
+    with open("tools/mb_results.jsonl", "a") as f:
+        for line in lines:
+            f.write(json.dumps(line) + "\n")
+
+
+if __name__ == "__main__":
+    main()

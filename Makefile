@@ -6,6 +6,9 @@
 #           peak-memory liveness, collective/mesh consistency, donation,
 #           roofline cost over the real entry points. Traces tiny
 #           configs under JAX_PLATFORMS=cpu; gates `test` like lint.
+# chaos   — the fault-injection suite (ISSUE 6): every named injection
+#           point must isolate/retry/degrade, never crash Engine.step().
+#           CPU-safe, deterministic (seed-driven plans); gates `test`.
 # test    — the virtual-8-CPU-device suite (mesh/sharding logic, kernel
 #           math in interpret mode). Safe anywhere.
 # onchip  — the real-TPU lane (VERDICT r3 #4): Pallas kernels through
@@ -19,7 +22,10 @@ lint:
 analyze:
 	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation
 
-test: lint analyze
+chaos:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py -q
+
+test: lint analyze chaos
 	python -m pytest tests/ -x -q --ignore=tests/onchip
 
 onchip:
@@ -28,4 +34,4 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: lint test onchip bench
+.PHONY: lint analyze chaos test onchip bench

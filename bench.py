@@ -300,6 +300,18 @@ def bench_spec(cfg, on_tpu):
         return {"spec_decode_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_fault(cfg, on_tpu):
+    """Fault-rate scenario (ISSUE 6): mixed serving with ~1% injected
+    request failures must hold throughput within 10% of clean with zero
+    engine restarts; failures are isolated and scrape-visible."""
+    try:
+        from paddle_tpu.inference.engine import bench_fault_tolerance
+
+        return bench_fault_tolerance(cfg, on_tpu)
+    except Exception as e:
+        return {"fault_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def main():
     from paddle_tpu.framework.compile_cache import enable_compilation_cache
     from paddle_tpu.models.gpt import GPTConfig
@@ -340,6 +352,7 @@ def main():
     decode = bench_decode(decode_cfg, on_tpu)
     paged = bench_paged_decode(decode_cfg, on_tpu)
     spec = bench_spec(decode_cfg, on_tpu)
+    fault = bench_fault(decode_cfg, on_tpu)
 
     # observability snapshot (ISSUE 3): the perf trajectory carries the
     # telemetry the run produced — how many programs compiled, whether
@@ -371,6 +384,18 @@ def main():
             spec_accepted / spec_proposed if spec_proposed else 0.0, 3),
         "decode_spec_ms_per_token": spec.get(
             "decode_spec_ms_per_token", 0.0),
+        # fault-tolerance surface (ISSUE 6): the taxonomy counters and
+        # degraded-mode gauge as the registry saw them across the run
+        "request_failures": int(
+            metric_total("paddle_tpu_request_failures_total")),
+        "admission_rejected": int(
+            metric_total("paddle_tpu_admission_rejected_total")),
+        "request_retries": int(
+            metric_total("paddle_tpu_request_retries_total")),
+        "engine_recoveries": int(
+            metric_total("paddle_tpu_engine_recoveries_total")),
+        "degraded_mode": int(
+            metric_total("paddle_tpu_engine_degraded")),
     }
 
     out = {
@@ -397,6 +422,7 @@ def main():
         **decode,
         **paged,
         **spec,
+        **fault,
         "metrics": metrics_block,
     }
     print(json.dumps(out))

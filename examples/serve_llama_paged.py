@@ -47,6 +47,22 @@ def main():
                     help="max draft tokens per verify step (the verify "
                          "block scores k+1 positions in one forward); "
                          "per-request depth adapts to an acceptance EMA")
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request TTL (ISSUE 6): a request that "
+                         "hasn't finished this many ms after submission "
+                         "fails with reason 'deadline' — queued or "
+                         "mid-decode — freeing its slot and pages")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="bounded wait queue: add_request raises "
+                         "QueueFull (backpressure) once this many "
+                         "requests are waiting for a slot")
+    ap.add_argument("--fault-inject", default=None,
+                    help="deterministic fault-injection plan "
+                         "(paddle_tpu.testing.faultinject grammar, e.g. "
+                         "'nan-logits:rid=2,times=1'); defaults to "
+                         "FLAGS_fault_inject / PADDLE_TPU_FAULT_INJECT. "
+                         "Faulted requests end FAILED with a taxonomy "
+                         "reason; the engine never dies")
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="serve Prometheus text exposition on this port "
                          "(/metrics); 0 picks an ephemeral port, printed "
@@ -107,7 +123,11 @@ def main():
                  chunk_size=8, dtype=jnp.float32,
                  quantized_cache=args.int8_cache,
                  spec=None if args.spec == "off" else args.spec,
-                 spec_k=args.spec_k, draft_model=draft_model)
+                 spec_k=args.spec_k, draft_model=draft_model,
+                 deadline_s=(args.deadline_ms / 1e3
+                             if args.deadline_ms is not None else None),
+                 max_queue=args.max_queue,
+                 fault_plan=args.fault_inject)
     rng = np.random.default_rng(0)
 
     # mixed-length requests, more requests than slots: admission interleaves
@@ -131,6 +151,13 @@ def main():
 
     for i, r in enumerate(reqs):
         assert r.done and streams[i] == r.tokens
+        if r.failed:
+            # fault tolerance (ISSUE 6): a failed request is terminal
+            # with an attributable taxonomy reason — the batch lived on
+            print(f"request {r.rid}: prompt {r.prompt.size:>2} -> "
+                  f"FAILED ({r.failure_reason}) after "
+                  f"{len(r.tokens)} tokens")
+            continue
         print(f"request {r.rid}: prompt {r.prompt.size:>2} -> "
               f"{len(r.tokens)} tokens (streamed {len(streams[i])})")
     print(f"pool fully recycled: {len(eng._free_pages)}/{free0} free "

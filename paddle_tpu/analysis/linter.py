@@ -175,6 +175,9 @@ class _ModuleAnalyzer:
         self.obs_aliases: Set[str] = set()       # names bound to the
         # observability package (absolute OR relative import) — receivers
         # of TPL601's "metrics call under trace" check
+        self.err_aliases: Set[str] = set()       # names imported from an
+        # errors module (the serving error taxonomy) — referencing one in
+        # a broad handler satisfies TPL701's wrapping requirement
         self.funcs: List[_FuncInfo] = []
         self.by_name: Dict[str, List[_FuncInfo]] = {}
         self.by_method: Dict[Tuple[str, str], List[_FuncInfo]] = {}
@@ -220,6 +223,9 @@ class _ModuleAnalyzer:
                 # (..observability) imports
                 if n.module and "observability" in n.module:
                     self.obs_aliases.update(a.asname or a.name
+                                            for a in n.names)
+                elif n.module and "errors" in n.module.split("."):
+                    self.err_aliases.update(a.asname or a.name
                                             for a in n.names)
                 else:
                     for a in n.names:
@@ -715,7 +721,59 @@ class _ModuleAnalyzer:
                           f"{scope_name!r} without being rebound — the "
                           f"buffer no longer belongs to this frame")
 
+    # -- TPL701: broad except outside the error taxonomy (inference/) ------
+
+    _BROAD_EXC_NAMES = {"Exception", "BaseException"}
+
+    def _is_broad_handler(self, h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        return any(_tail_name(t) in self._BROAD_EXC_NAMES for t in types)
+
+    def _handler_routes_to_taxonomy(self, h: ast.ExceptHandler) -> bool:
+        """A broad handler is compliant when its body (a) re-raises, (b)
+        constructs/references a name imported from an errors module (the
+        taxonomy), or (c) calls a *fail*/*fault*-named handler (the
+        ``_fail_request`` / ``_recover_step_fault`` convention) — i.e.
+        the swallowed exception demonstrably becomes a typed failure."""
+        for n in ast.walk(h):
+            if isinstance(n, ast.Raise):
+                return True
+            if isinstance(n, ast.Call):
+                tail = _tail_name(n.func)
+                if tail and ("fail" in tail.lower()
+                             or "fault" in tail.lower()):
+                    return True
+            if isinstance(n, ast.Name) and n.id in self.err_aliases:
+                return True
+            if isinstance(n, ast.Attribute) and n.attr in self.err_aliases:
+                return True
+        return False
+
+    def _check_error_handling(self):
+        """TPL701 — serving-path (inference/) modules only: the ISSUE 6
+        fault-tolerance contract makes untyped exception swallowing a
+        correctness bug there (a failure that never reaches the FAILED
+        state or the failure metrics). Other paths keep the laxer
+        module-wide TPL501 (bare except) rule alone."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("inference" in p for p in parts):
+            return
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ExceptHandler) \
+                    and self._is_broad_handler(n) \
+                    and not self._handler_routes_to_taxonomy(n):
+                shown = (_tail_name(n.type) or "bare"
+                         if n.type is not None else "bare")
+                self._add(R.BROAD_EXCEPT_UNTYPED, n,
+                          f"broad `except {shown}` on the serving path "
+                          "neither re-raises nor routes into the error "
+                          "taxonomy (raise a paddle_tpu.inference.errors "
+                          "type or call a *fail*/*fault* handler)")
+
     def _check_module_wide(self):
+        self._check_error_handling()
         # TPL304: module-bound donating wrappers are callable from any
         # function below, so function scopes inherit the module's set
         module_wrappers = self._collect_donating_wrappers(self.tree)

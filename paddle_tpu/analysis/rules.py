@@ -22,6 +22,11 @@ Families (first digit of the numeric part):
 * ``6xx`` — observability: telemetry recorded from the wrong side of the
   trace boundary (metrics must be host-side; under trace they run once
   at trace time or capture tracers).
+* ``7xx`` — error-handling: exception discipline on the serving path
+  (``inference/`` modules), where ISSUE 6's fault-tolerance contract
+  requires every caught failure to be re-raised or routed into the
+  error taxonomy — a silently swallowed exception there is a request
+  that never reaches FAILED and a metric that never moves.
 """
 from __future__ import annotations
 
@@ -131,6 +136,18 @@ OBSERVABILITY_IN_TRACE = _rule(
     "tensor-derived sample is a tracer the metric cannot hold. Record on "
     "the host, outside the compiled region — return the value out of the "
     "trace if it is tensor-derived.")
+
+
+BROAD_EXCEPT_UNTYPED = _rule(
+    "TPL701", "error-handling", "broad-except-outside-taxonomy",
+    "bare `except:` / broad `except Exception` in an inference/ (serving-"
+    "path) module whose handler neither re-raises nor routes the failure "
+    "into the error taxonomy (raising/constructing a "
+    "paddle_tpu.inference.errors type, or calling a *fail*/*fault* "
+    "handler like Engine._fail_request): the fault-tolerance contract "
+    "(ISSUE 6) requires every swallowed exception to become a terminal "
+    "FAILED request or a counted engine fault — silent swallowing hides "
+    "the failure from both the caller and the metrics.")
 
 
 FAMILIES = sorted({r.family for r in RULES.values()})

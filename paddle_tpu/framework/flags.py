@@ -79,6 +79,17 @@ define_flag("FLAGS_analyze_on_compile",
             "adds one make_jaxpr per compile (~ms at serving shapes, "
             "more for big train steps); also settable via env "
             "PADDLE_TPU_ANALYZE_ON_COMPILE=1")
+define_flag("FLAGS_fault_inject",
+            os.environ.get("PADDLE_TPU_FAULT_INJECT", ""),
+            "deterministic fault-injection plan for the serving engine "
+            "(paddle_tpu.testing.faultinject; ISSUE 6). Grammar: "
+            "'point[:key=val,...][;point2:...]' over the named points "
+            "pool-exhaustion / step-exception / nan-logits / "
+            "drafter-corruption / slow-step, e.g. "
+            "'nan-logits:rid=2,times=1;slow-step:every=4,delay_ms=30'. "
+            "Empty (the default) disables injection; also settable via "
+            "env PADDLE_TPU_FAULT_INJECT. Engine(fault_plan=...) "
+            "overrides per instance")
 define_flag("FLAGS_check_tracers",
             os.environ.get("PADDLE_TPU_CHECK_TRACERS", "").lower()
             in ("1", "true", "yes"),

@@ -1,0 +1,167 @@
+"""Structured error taxonomy for the serving engine (ISSUE 6 tentpole).
+
+The paged engine's original failure surface was a handful of raw
+``RuntimeError``/``ValueError`` raises mid-``step()`` — one bad request
+killed the whole batch. Production engines in the vLLM/Orca lineage treat
+per-request fault isolation as table stakes, so every failure the serving
+stack can produce now has a typed home here, split along the one axis that
+matters operationally: *whose* fault is it, and therefore *what dies*.
+
+* ``RequestError`` subtree — scoped to ONE request. The engine catches
+  these (and anything unexpected raised while processing one request),
+  moves that request to the terminal ``FAILED`` state with
+  ``failure_reason`` set to the class's ``reason`` slug, frees its slot
+  and pages, and keeps serving everything else. The ``reason`` slug is
+  the label on ``paddle_tpu_request_failures_total{reason}``, so the
+  taxonomy here IS the metrics schema — add a class, get a series.
+* ``EngineFault`` — a whole-step fault (a compiled dispatch died, host
+  bookkeeping is mid-commit). ``Engine.step()`` never re-raises it
+  either: recovery requeues every active request (recompute policy — the
+  prefix re-prefills, the PRNG key travels, generation resumes exactly)
+  and the watchdog counts it toward graceful degradation
+  (``paddle_tpu/inference/watchdog.py``).
+
+Admission-time classes double-inherit ``ValueError`` so existing callers
+(and tests) that catch ``ValueError`` on ``add_request`` keep working —
+reject-at-submission predates the taxonomy, only its type got sharper.
+
+Pure stdlib; importing this module must never pull in jax.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = [
+    "EngineError", "RequestError", "ValidationError", "AdmissionRejected",
+    "QueueFull", "DeadlineExceeded", "CancelledError", "PoolExhausted",
+    "NumericsError", "DrafterFault", "StepFault", "CallbackError",
+    "RetriesExhausted", "EngineFault", "failure_reason",
+]
+
+
+class EngineError(Exception):
+    """Base of every taxonomy error the serving stack raises.
+
+    ``reason`` is the stable metrics slug
+    (``paddle_tpu_request_failures_total{reason=...}``) and the value
+    stored on ``Request.failure_reason`` — treat it like a rule ID:
+    never rename, retire and mint instead.
+    """
+
+    reason = "engine"
+
+    def __init__(self, message: str = "", rid: Optional[int] = None):
+        super().__init__(message)
+        self.rid = rid
+
+
+class RequestError(EngineError):
+    """A fault scoped to one request: the engine fails THAT request
+    (terminal ``FAILED`` state carrying ``reason``) and the co-batched
+    requests keep decoding, bit-identical to a fault-free run."""
+
+    reason = "request"
+
+
+class ValidationError(RequestError, ValueError):
+    """The request is malformed at submission: empty prompt, token ids
+    outside the vocab, non-integer ids, a non-positive budget, or a
+    prompt that leaves no room to generate. Rejected at ``add_request``
+    — it never enters the queue."""
+
+    reason = "validation"
+
+
+class AdmissionRejected(RequestError, ValueError):
+    """The request can NEVER be served by this engine's geometry (needs
+    more KV pages than the pool/table can hold). Rejected at
+    ``add_request`` so the scheduler never spins waiting for pages that
+    cannot exist."""
+
+    reason = "admission_rejected"
+
+
+class QueueFull(AdmissionRejected):
+    """Backpressure: the bounded wait queue (``Engine(max_queue=...)``)
+    is at capacity. Callers shed or retry later — the engine refuses to
+    buffer unboundedly."""
+
+    reason = "queue_full"
+
+
+class DeadlineExceeded(RequestError):
+    """The request's deadline/TTL elapsed (queued or mid-decode). The
+    engine expires it at the next scheduling step."""
+
+    reason = "deadline"
+
+
+class CancelledError(RequestError):
+    """Host-side ``Engine.cancel(request_id)`` hit the request before it
+    finished."""
+
+    reason = "cancelled"
+
+
+class PoolExhausted(RequestError):
+    """KV page pool pressure this request cannot survive: it is alone in
+    the batch (nobody left to preempt) and still cannot get pages, or
+    its sequence outgrew the per-sequence page table."""
+
+    reason = "pool_exhausted"
+
+
+class NumericsError(RequestError):
+    """The in-program NaN/inf logit guard flagged this request's row —
+    its tokens are garbage (argmax over NaN) and are discarded rather
+    than streamed."""
+
+    reason = "nan_logits"
+
+
+class DrafterFault(RequestError):
+    """The speculative-decoding drafter raised (or was fault-injected).
+    The step falls back to drafting nothing — a zero-draft verify is
+    exactly a vanilla decode step, so greedy output is unchanged — and
+    the watchdog counts the fault toward spec→vanilla degradation."""
+
+    reason = "drafter"
+
+
+class StepFault(RequestError):
+    """An unexpected exception while processing ONE request's harvest /
+    bookkeeping. Wraps the original as ``__cause__``."""
+
+    reason = "step_fault"
+
+
+class CallbackError(StepFault):
+    """The request's ``on_token`` streaming callback raised. The
+    callback belongs to the caller; its failure fails the request, never
+    the batch."""
+
+    reason = "callback"
+
+
+class RetriesExhausted(RequestError):
+    """The request was preempted/requeued more than ``max_retries``
+    times. The bound converts allocator livelock (two big requests
+    endlessly evicting each other) into one bounded, attributable
+    failure."""
+
+    reason = "retries_exhausted"
+
+
+class EngineFault(EngineError):
+    """A whole-step fault: the compiled dispatch (or the step's host
+    spine) raised. Recovery is engine-level — requeue-all + pool reset —
+    not per-request."""
+
+    reason = "engine"
+
+
+def failure_reason(exc: BaseException) -> str:
+    """The metrics/``Request.failure_reason`` slug for any exception:
+    the taxonomy class's ``reason``, or ``"unhandled"`` for foreign
+    exception types (which the engine wraps in ``StepFault`` anyway)."""
+    return getattr(exc, "reason", None) or "unhandled"

@@ -54,6 +54,11 @@ class _SpecMetrics:
             "paddle_tpu_spec_tokens_per_verify_step",
             "tokens landed per request per verify step (1 + accepted)",
             buckets=SIZE_BUCKETS)
+        self.drafter_faults = counter(
+            "paddle_tpu_spec_drafter_faults_total",
+            "drafter proposals that raised (step fell back to zero "
+            "drafts — vanilla-equivalent)",
+            labelnames=("drafter",)).labels(drafter=drafter_name)
 
 
 class SpecDecoder:
@@ -94,6 +99,8 @@ class SpecDecoder:
         self.tokens_landed = 0     # tokens delivered via spec steps
         self.drafts_proposed = 0
         self.drafts_accepted = 0
+        self.drafter_faults = 0    # proposals that raised (ISSUE 6)
+        self.last_drafter_fault = None
         self.wall_seconds = 0.0    # _spec_step wall covered by the above
 
     # ---------------------------------------------------------- programs
@@ -133,6 +140,17 @@ class SpecDecoder:
         self.verify_steps += 1
         self.wall_seconds += wall
 
+    def note_drafter_fault(self, exc: BaseException):
+        """Drafter raised (ISSUE 6): count it and reset the drafter's
+        private cache so the next proposal re-syncs every slot from the
+        request's host-side token history — the slot-reconciliation-
+        after-failure contract. ``reset()`` never raises by contract."""
+        self.drafter_faults += 1
+        self.last_drafter_fault = exc
+        self.drafter.reset()
+        if self._m is not None:
+            self._m.drafter_faults.inc()
+
     def stats(self) -> dict:
         """Rolling summary: mean landed tokens per request-row per verify
         step, draft acceptance rate, measured spec ms/token."""
@@ -147,6 +165,7 @@ class SpecDecoder:
             "accept_rate": (
                 self.drafts_accepted / self.drafts_proposed
                 if self.drafts_proposed else 0.0),
+            "drafter_faults": self.drafter_faults,
             "spec_ms_per_token": (
                 1e3 * self.wall_seconds / self.tokens_landed
                 if self.tokens_landed else 0.0),

@@ -100,6 +100,9 @@ class NgramDrafter:
     def release(self, slot):  # stateless
         pass
 
+    def reset(self):  # stateless; part of the drafter fault contract —
+        pass          # reset() must never raise (engine calls it bare)
+
 
 class DraftModelDrafter:
     """Draft with a small causal LM over its own paged KV pool."""
@@ -171,6 +174,25 @@ class DraftModelDrafter:
         self.tables[slot, :] = 0
         self.lengths[slot] = 0
         self._slot_rid[slot] = -1
+
+    def reset(self):
+        """Drop ALL drafter state and rebuild the page buffers (ISSUE 6:
+        slot reconciliation after a drafter fault or engine pool reset).
+        Safe because ``_sync`` re-prefills any slot whose cache doesn't
+        match the request's host-side history — which after this is
+        every slot. Must never raise."""
+        import jax.numpy as jnp
+
+        n_kv = getattr(self.cfg, "num_kv_heads", self.cfg.num_heads)
+        shape = (self.num_pages, self.page_size, n_kv * self.cfg.head_dim)
+        self.k_pages = [jnp.zeros(shape, self.dtype)
+                        for _ in range(self.cfg.num_layers)]
+        self.v_pages = [jnp.zeros(shape, self.dtype)
+                        for _ in range(self.cfg.num_layers)]
+        self.tables[:] = 0
+        self.lengths[:] = 0
+        self._free_pages = list(range(self.num_pages - 1, 0, -1))
+        self._slot_rid[:] = -1
 
     # ------------------------------------------------------ jit bodies
     def _states_from(self, pages_flat, tables, lengths, verify=False):

@@ -50,6 +50,10 @@ def make_verify_fn(engine, sampling):
                                                caches=states)
             lg = (logits._data if isinstance(logits, Tensor)
                   else logits).astype(jnp.float32)
+            # NaN/inf logit guard (ISSUE 6): any non-finite position in a
+            # row's k+1 scored logits poisons acceptance for that row —
+            # flag it so the host fails THAT request, not the batch
+            bad = ~jnp.all(jnp.isfinite(lg), axis=(1, 2))
             toks, n_emit, new_keys = accept_tokens(
                 lg, drafts, draft_len, temps, keys,
                 top_k=engine.top_k, sampling=sampling)
@@ -60,7 +64,7 @@ def make_verify_fn(engine, sampling):
             cap = tables.shape[1] * engine.page_size
             new_lengths = jnp.where(
                 active, jnp.minimum(lengths + n_emit, cap), lengths)
-            return (toks, n_emit, new_lengths, new_keys,
+            return (toks, n_emit, new_lengths, new_keys, bad,
                     engine._pages_of(new_states))
 
     return spec_verify_step

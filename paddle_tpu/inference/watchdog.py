@@ -1,0 +1,133 @@
+"""Graceful degradation for the paged serving engine (ISSUE 6 tentpole,
+part 3): a watchdog that detects repeated step failures, drafter faults,
+and drafter-acceptance collapse, and DOWNGRADES the engine instead of
+letting it die — then probes its way back up once the storm passes.
+
+Degraded-mode state machine (one axis, monotone levels)::
+
+    0 HEALTHY      spec decode on (if configured), full admission cap
+    1 NO_SPEC      spec decode forced off -> vanilla chained decode
+                   (greedy output identical by construction - PR 5's
+                   correctness invariant survives degradation)
+    2 SMALL_BATCH  admission cap halved on top of NO_SPEC: fewer slots,
+                   less page pressure, smaller blast radius per step
+
+Transitions DOWN happen when a fault counter crosses its threshold:
+``step_fault_threshold`` consecutive whole-step faults, or
+``drafter_fault_threshold`` consecutive drafter faults, or a full
+acceptance window whose draft-acceptance rate sits below
+``accept_floor`` (drafting is pure overhead at that point). Transitions
+UP are recovery probes: after ``recover_after`` consecutive healthy
+steps the level steps back toward HEALTHY one notch at a time, with the
+fault counters and acceptance window cleared so a relapse is judged on
+fresh evidence, not the stale storm.
+
+The current level is exported as the ``paddle_tpu_engine_degraded``
+gauge (0/1/2), so dashboards can alert on "engine survived but is
+running degraded" — the state the whole layer exists to make reachable.
+All of this is host-side scheduler code; nothing here is ever traced.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+__all__ = ["Watchdog", "HEALTHY", "NO_SPEC", "SMALL_BATCH"]
+
+HEALTHY, NO_SPEC, SMALL_BATCH = 0, 1, 2
+_LEVEL_NAMES = {HEALTHY: "healthy", NO_SPEC: "no-spec",
+                SMALL_BATCH: "small-batch"}
+
+
+class Watchdog:
+    def __init__(self, engine, step_fault_threshold: int = 3,
+                 drafter_fault_threshold: int = 3,
+                 accept_floor: float = 0.05, accept_window: int = 32,
+                 recover_after: int = 64):
+        self.engine = engine
+        self.step_fault_threshold = int(step_fault_threshold)
+        self.drafter_fault_threshold = int(drafter_fault_threshold)
+        self.accept_floor = float(accept_floor)
+        self.recover_after = int(recover_after)
+        self.level = HEALTHY
+        self.last_fault: Optional[BaseException] = None
+        self._consec_step_faults = 0
+        self._consec_drafter_faults = 0
+        self._healthy_steps = 0
+        # (proposed, accepted) per spec step; collapse is judged over a
+        # FULL window so one unlucky batch can't flap the mode
+        self._accept = deque(maxlen=int(accept_window))
+        self._apply()
+
+    # ------------------------------------------------------------ events
+    def note_step_ok(self):
+        """A scheduling step completed without an engine-level fault."""
+        self._consec_step_faults = 0
+        self._healthy_steps += 1
+        if self.level > HEALTHY and self._healthy_steps >= self.recover_after:
+            self._recover()
+
+    def note_step_fault(self, exc: BaseException):
+        """A whole-step fault (dispatch died / host spine raised)."""
+        self.last_fault = exc
+        self._healthy_steps = 0
+        self._consec_step_faults += 1
+        if self._consec_step_faults >= self.step_fault_threshold:
+            self._consec_step_faults = 0
+            self._degrade()
+
+    def note_drafter_fault(self):
+        """The spec drafter raised; the step fell back to zero drafts."""
+        self._healthy_steps = 0
+        self._consec_drafter_faults += 1
+        if self._consec_drafter_faults >= self.drafter_fault_threshold:
+            self._consec_drafter_faults = 0
+            if self.level < NO_SPEC:
+                self.level = NO_SPEC
+                self._apply()
+
+    def note_drafter_ok(self):
+        self._consec_drafter_faults = 0
+
+    def note_acceptance(self, proposed: int, accepted: int):
+        """One spec step's batch-wide draft acceptance. A full window
+        under ``accept_floor`` means drafting burns a dispatch per step
+        for nothing — degrade to vanilla, recover-probe later."""
+        if proposed <= 0:
+            return
+        self._accept.append((proposed, accepted))
+        if len(self._accept) < self._accept.maxlen:
+            return
+        prop = sum(p for p, _ in self._accept)
+        acc = sum(a for _, a in self._accept)
+        if prop > 0 and acc / prop < self.accept_floor \
+                and self.level < NO_SPEC:
+            self._accept.clear()
+            self.level = NO_SPEC
+            self._apply()
+
+    # ----------------------------------------------------- state machine
+    def _degrade(self):
+        if self.level < SMALL_BATCH:
+            self.level += 1
+            self._apply()
+
+    def _recover(self):
+        self.level -= 1
+        self._healthy_steps = 0
+        self._consec_step_faults = 0
+        self._consec_drafter_faults = 0
+        self._accept.clear()
+        self._apply()
+
+    def _apply(self):
+        eng = self.engine
+        eng._spec_enabled = self.level < NO_SPEC
+        eng._slot_cap = (eng.max_slots if self.level < SMALL_BATCH
+                         else max(1, eng.max_slots // 2))
+        if eng._m is not None:
+            eng._m.degraded.set(self.level)
+
+    @property
+    def mode(self) -> str:
+        return _LEVEL_NAMES[self.level]

@@ -1,0 +1,11 @@
+"""paddle_tpu.testing — test-support utilities that ship in the package
+(not under tests/) because production code cooperates with them: the
+serving engine carries named fault-injection hook sites that
+``faultinject.FaultPlan`` drives (ISSUE 6), the same way the chaos suite
+and a staging deployment would.
+
+Pure stdlib + numpy at import time; never pulls in jax.
+"""
+from .faultinject import POINTS, FaultPlan, InjectedFault, plan_from_flags
+
+__all__ = ["FaultPlan", "InjectedFault", "POINTS", "plan_from_flags"]

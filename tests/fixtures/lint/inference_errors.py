@@ -1,0 +1,61 @@
+"""TPL701 fixtures — error-handling discipline on the serving path.
+
+The filename carries ``inference`` so the path gate treats this module as
+serving-path code: broad exception handlers here must re-raise or route
+the failure into the error taxonomy (ISSUE 6 fault-tolerance contract).
+"""
+from paddle_tpu.inference.errors import StepFault
+
+
+def bad_swallow(engine):
+    try:
+        return engine.step()
+    except Exception:  # EXPECT: TPL701
+        return None
+
+
+def bad_bare_swallow(engine):
+    try:
+        return engine.step()
+    except:  # noqa: E722  # EXPECT: TPL701  # EXPECT: TPL501
+        return -1
+
+
+def bad_logged_not_typed(engine, log):
+    try:
+        return engine.step()
+    except Exception as e:  # EXPECT: TPL701
+        log.warning("step blew up: %r", e)
+        return 0
+
+
+def good_reraise_wrapped(engine):
+    try:
+        return engine.step()
+    except Exception as e:
+        raise StepFault(f"step failed: {e}") from e
+
+
+def good_fails_request(engine, req):
+    try:
+        return engine.step()
+    except Exception as e:
+        engine._fail_request(req, e)
+        return 0
+
+
+def good_narrow_catch(engine):
+    try:
+        return engine.step()
+    except KeyError:  # narrow: outside TPL701's scope by design
+        return 0
+
+
+def suppressed_swallow(engine):
+    try:
+        return engine.step()
+    # tpulint: disable=TPL701,TPL501 -- fixture: demonstrates a justified
+    # suppression (a top-level serve loop that must never die and reports
+    # through its own channel)
+    except:  # noqa: E722  # EXPECT-SUPPRESSED: TPL701 EXPECT-SUPPRESSED: TPL501
+        return None

@@ -1,0 +1,479 @@
+"""Chaos suite (ISSUE 6): under every named fault-injection point the
+engine must ISOLATE (the faulted request reaches terminal FAILED with a
+taxonomy reason while co-batched requests produce tokens identical to a
+fault-free run), RETRY (bounded recompute re-queues), or DEGRADE
+(spec→vanilla, admission cap) — and ``Engine.step()`` must never raise.
+Also covers the lifecycle hardening satellites: admission validation,
+bounded-queue backpressure, deadline/TTL, cancel, retry bounds,
+idempotent slot release, and Prometheus visibility of the whole failure
+surface. Runs on CPU as part of tier-1 (``make chaos``)."""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.inference.errors import (
+    AdmissionRejected,
+    QueueFull,
+    ValidationError,
+)
+from paddle_tpu.inference.watchdog import HEALTHY, NO_SPEC
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import metric_total, render_prometheus
+from paddle_tpu.testing.faultinject import FaultPlan
+
+PLENS = (5, 12, 9, 7)
+BUDGET = 10
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                    max_position=128, vocab_size=97)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def make_engine(gpt, plan=None, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("dtype", jnp.float32)
+    return Engine(gpt, fault_plan=plan, **kw)
+
+
+def workload(eng, budget=BUDGET):
+    r = np.random.default_rng(0)
+    return [eng.add_request(r.integers(0, 97, (n,)), budget)
+            for n in PLENS]
+
+
+@pytest.fixture(scope="module")
+def clean(gpt):
+    """Fault-free baseline token streams, by request index."""
+    eng = make_engine(gpt)
+    reqs = workload(eng)
+    eng.run()
+    assert all(r.done and not r.failed for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+def assert_healthy_match(reqs, clean, faulted_idx):
+    """The chaos invariant: every non-faulted request completes with
+    tokens identical to the fault-free run."""
+    for i, r in enumerate(reqs):
+        if i in faulted_idx:
+            continue
+        assert r.done and not r.failed, f"request {i} did not complete"
+        assert list(r.tokens) == clean[i], (
+            f"request {i} diverged from the fault-free run")
+
+
+class TestInjectionPoints:
+    def test_step_exception_isolates_one_request(self, gpt, clean):
+        fail0 = metric_total("paddle_tpu_request_failures_total")
+        eng = make_engine(gpt, plan="step-exception:rid=1,at=1")
+        reqs = workload(eng)
+        eng.run()  # must not raise
+        assert reqs[1].state == "FAILED"
+        assert reqs[1].failure_reason == "step_fault"
+        assert reqs[1].failure.__cause__ is not None
+        assert_healthy_match(reqs, clean, {1})
+        # metrics recorded the failure, and the injection hook fired
+        assert metric_total("paddle_tpu_request_failures_total") > fail0
+        assert eng._fi.fired("step-exception") == 1
+        # every page and slot came back
+        assert len(eng._free_pages) == eng.num_pages - 1
+        assert np.all(eng.tables == 0)
+
+    def test_nan_logits_injection_isolates(self, gpt, clean):
+        eng = make_engine(gpt, plan="nan-logits:rid=2,times=1")
+        reqs = workload(eng)
+        eng.run()
+        assert reqs[2].state == "FAILED"
+        assert reqs[2].failure_reason == "nan_logits"
+        assert_healthy_match(reqs, clean, {2})
+
+    def test_real_nan_logits_guard(self, rng):
+        """Not injected: a genuinely NaN-poisoned model must trip the
+        in-program isfinite guard — request FAILED (reason nan_logits,
+        no garbage tokens streamed), engine alive."""
+        paddle.seed(3)
+        cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                        max_position=128, vocab_size=97)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        name, p = next(iter(model.named_parameters()))
+        p._data = jnp.full_like(p._data, jnp.nan)
+        eng = make_engine(model)
+        req = eng.add_request(rng.integers(0, 97, (6,)), 8)
+        eng.run()  # must not raise
+        assert req.state == "FAILED"
+        assert req.failure_reason == "nan_logits"
+        assert req.tokens == []
+        assert len(eng._free_pages) == eng.num_pages - 1
+
+    def test_pool_exhaustion_bounded_retries_absorbed(self, gpt, clean):
+        """A transient injected exhaustion must be absorbed (chain
+        shrink / preemption-recompute): every request still completes
+        with tokens identical to the fault-free run."""
+        eng = make_engine(gpt, plan="pool-exhaustion:at=2,times=2")
+        reqs = workload(eng)
+        eng.run()
+        assert eng._fi.fired("pool-exhaustion") >= 1
+        assert_healthy_match(reqs, clean, set())
+
+    def test_pool_exhaustion_persistent_fails_not_raises(self, gpt):
+        """Persistent exhaustion (every allocation refused) must end in
+        FAILED pool_exhausted requests — never a RuntimeError out of
+        step() (the pre-ISSUE-6 behavior)."""
+        eng = make_engine(gpt, plan="pool-exhaustion:every=1")
+        reqs = workload(eng)
+        eng.run()  # terminates, no raise
+        assert all(r.state == "FAILED" for r in reqs)
+        assert all(r.failure_reason == "pool_exhausted" for r in reqs)
+
+    def test_slow_step_drives_deadline_expiry(self, gpt):
+        eng = make_engine(gpt, plan="slow-step:every=1,delay_ms=30",
+                          deadline_s=0.01)
+        reqs = workload(eng)
+        t0 = time.perf_counter()
+        eng.run()
+        assert time.perf_counter() - t0 < 30  # run() terminated promptly
+        assert all(r.state == "FAILED" for r in reqs)
+        assert all(r.failure_reason == "deadline" for r in reqs)
+
+    def test_deadline_expires_active_request_and_recycles(self, gpt, rng):
+        """A request that expires MID-decode frees its slot and pages
+        the same step; batchmates keep going."""
+        eng = make_engine(gpt, plan="slow-step:every=1,delay_ms=25")
+        doomed = eng.add_request(rng.integers(0, 97, (6,)), 60,
+                                 deadline_s=0.03)
+        safe = eng.add_request(rng.integers(0, 97, (6,)), 6)
+        eng.run()
+        assert doomed.state == "FAILED"
+        assert doomed.failure_reason == "deadline"
+        assert safe.done and not safe.failed and len(safe.tokens) == 6
+        assert len(eng._free_pages) == eng.num_pages - 1
+        assert np.all(eng.tables == 0)
+
+    def test_drafter_fault_falls_back_to_vanilla(self, gpt, clean):
+        """Drafter raising EVERY step: zero-draft fallback keeps greedy
+        output identical to vanilla (PR 5 invariant through degradation),
+        and the watchdog disables spec after the fault threshold."""
+        eng = make_engine(gpt, plan="drafter-corruption:every=1",
+                          spec="ngram", spec_k=4)
+        reqs = workload(eng)
+        eng.run()
+        assert_healthy_match(reqs, clean, set())
+        assert eng._spec.drafter_faults >= 1
+        # threshold (3 consecutive) must have tripped spec→vanilla
+        assert eng._watchdog.level >= NO_SPEC
+        assert eng._spec_enabled is False
+
+    def test_draft_model_drafter_fault_resync(self, gpt, clean):
+        """Draft-LM drafter faulting intermittently: each fault resets
+        its private paged cache, and the next proposal re-syncs every
+        slot from the request's host-side history (slot reconciliation
+        after failure) — greedy output stays identical throughout."""
+        paddle.seed(5)
+        dcfg = GPTConfig(hidden_size=32, num_layers=1, num_heads=2,
+                         max_position=128, vocab_size=97)
+        dm = GPTForCausalLM(dcfg)
+        dm.eval()
+        eng = make_engine(gpt, plan="drafter-corruption:every=3",
+                          spec="draft", draft_model=dm, spec_k=4)
+        reqs = workload(eng)
+        eng.run()
+        assert_healthy_match(reqs, clean, set())
+        assert eng._spec.drafter_faults >= 1
+        d = eng._spec.drafter
+        assert np.all(d.tables == 0)
+        assert len(set(d._free_pages)) == len(d._free_pages)
+
+    def test_drafter_corruption_rejected_by_verifier(self, gpt, clean):
+        """Corrupted draft TOKENS (not a raise): acceptance only ever
+        keeps tokens matching the target argmax, so output is identical
+        and nothing fails."""
+        eng = make_engine(gpt, plan="drafter-corruption:every=1,corrupt=1",
+                          spec="ngram", spec_k=4)
+        reqs = workload(eng)
+        eng.run()
+        assert_healthy_match(reqs, clean, set())
+        assert all(not r.failed for r in reqs)
+
+
+class TestEngineFaultRecovery:
+    def test_dispatch_death_recovers_exactly(self, gpt, clean,
+                                             monkeypatch):
+        """A compiled decode dispatch dying once: requeue-all recompute
+        + pool reset must resume every request exactly (same tokens as
+        the fault-free run), with one recovery counted."""
+        rec0 = metric_total("paddle_tpu_engine_recoveries_total")
+        orig = Engine._get_decode
+        state = {"armed": True}
+
+        def dying_get_decode(self, nb, k, sampling):
+            fn = orig(self, nb, k, sampling)
+
+            def wrapper(*a, **kw):
+                if state["armed"]:
+                    state["armed"] = False
+                    raise RuntimeError("injected dispatch death")
+                return fn(*a, **kw)
+
+            return wrapper
+
+        monkeypatch.setattr(Engine, "_get_decode", dying_get_decode)
+        eng = make_engine(gpt)
+        reqs = workload(eng)
+        eng.run()  # must not raise
+        assert_healthy_match(reqs, clean, set())
+        assert metric_total("paddle_tpu_engine_recoveries_total") == rec0 + 1
+        assert len(eng._free_pages) == eng.num_pages - 1
+
+    def test_permanent_dispatch_death_degrades_and_bounds(self, gpt,
+                                                          monkeypatch):
+        """Every decode dispatch dying: requests must fail with
+        retries_exhausted after the bound (run() terminates!) and the
+        watchdog must have degraded the engine."""
+
+        def always_dying(self, nb, k, sampling):
+            def wrapper(*a, **kw):
+                raise RuntimeError("permanent dispatch death")
+
+            return wrapper
+
+        monkeypatch.setattr(Engine, "_get_decode", always_dying)
+        eng = make_engine(gpt, max_retries=2)
+        reqs = workload(eng)
+        eng.run()  # bounded: terminates without raising
+        assert all(r.state == "FAILED" for r in reqs)
+        assert all(r.failure_reason == "retries_exhausted" for r in reqs)
+        assert eng._watchdog.level > HEALTHY
+        assert metric_total("paddle_tpu_engine_degraded") >= 1
+
+    def test_watchdog_recovery_probe_restores(self, gpt):
+        """After degradation, recover_after healthy steps probe back to
+        HEALTHY and re-enable spec."""
+        eng = make_engine(gpt, spec="ngram",
+                          watchdog={"recover_after": 2,
+                                    "drafter_fault_threshold": 2})
+        wd = eng._watchdog
+        wd.note_drafter_fault()
+        wd.note_drafter_fault()
+        assert wd.level == NO_SPEC and eng._spec_enabled is False
+        wd.note_step_ok()
+        wd.note_step_ok()
+        assert wd.level == HEALTHY and eng._spec_enabled is True
+
+    def test_acceptance_collapse_disables_spec(self, gpt):
+        eng = make_engine(gpt, spec="ngram",
+                          watchdog={"accept_window": 8,
+                                    "accept_floor": 0.1})
+        wd = eng._watchdog
+        for _ in range(8):
+            wd.note_acceptance(proposed=4, accepted=0)
+        assert wd.level == NO_SPEC and eng._spec_enabled is False
+
+
+class TestLifecycle:
+    def test_validation_rejected_at_submission(self, gpt):
+        eng = make_engine(gpt)
+        rej0 = metric_total("paddle_tpu_admission_rejected_total")
+        with pytest.raises(ValidationError):
+            eng.add_request(np.zeros((0,), np.int32), 4)      # empty
+        with pytest.raises(ValidationError):
+            eng.add_request(np.array([1.5, 2.5]), 4)          # floats
+        with pytest.raises(ValidationError):
+            eng.add_request(np.array([5, 400]), 4)            # OOV
+        with pytest.raises(ValidationError):
+            eng.add_request(np.array([-1, 3]), 4)             # negative
+        with pytest.raises(ValidationError):
+            eng.add_request(np.array([1, 2]), 0)              # no budget
+        with pytest.raises(ValidationError):
+            eng.add_request(np.array([1, 2]), 4, temperature=-1.0)
+        assert not eng._queue  # nothing entered the engine
+        assert metric_total(
+            "paddle_tpu_admission_rejected_total") == rej0 + 6
+
+    def test_oversized_prompt_rejected_up_front(self, gpt):
+        """ISSUE 6 satellite: a sequence the pool can never hold is an
+        AdmissionRejected at add_request — never a mid-step error."""
+        eng = make_engine(gpt, num_pages=8)
+        with pytest.raises(AdmissionRejected, match="pages"):
+            eng.add_request(np.zeros(90, np.int32), 20)
+        # taxonomy errors stay ValueError-compatible for old callers
+        assert issubclass(AdmissionRejected, ValueError)
+
+    def test_queue_backpressure(self, gpt, rng):
+        eng = make_engine(gpt, max_queue=2)
+        eng.add_request(rng.integers(0, 97, (5,)), 4)
+        eng.add_request(rng.integers(0, 97, (5,)), 4)
+        with pytest.raises(QueueFull):
+            eng.add_request(rng.integers(0, 97, (5,)), 4)
+        eng.run()  # the two admitted requests are unaffected
+
+    def test_cancel_queued_and_active(self, gpt, rng):
+        eng = make_engine(gpt, max_slots=2, max_chain=1)
+        active = eng.add_request(rng.integers(0, 97, (5,)), 30)
+        mate = eng.add_request(rng.integers(0, 97, (5,)), 30)
+        queued = eng.add_request(rng.integers(0, 97, (5,)), 6)
+        eng.step()
+        assert active.slot is not None and queued.slot is None
+        assert eng.cancel(queued.rid) is True
+        assert eng.cancel(active.rid) is True
+        assert eng.cancel(9999) is False
+        assert queued.state == "FAILED"
+        assert queued.failure_reason == "cancelled"
+        assert active.state == "FAILED" and active.slot is None
+        eng.run()
+        assert mate.done and not mate.failed
+        assert eng.cancel(mate.rid) is False  # terminal already
+        assert len(eng._free_pages) == eng.num_pages - 1
+
+    def test_on_token_callback_fault_isolates(self, gpt, clean):
+        """A streaming callback raising fails ITS request (reason
+        callback) and nobody else."""
+
+        def bomb(ts):
+            raise ValueError("user callback bug")
+
+        eng = make_engine(gpt)
+        r = np.random.default_rng(0)
+        reqs = []
+        for i, n in enumerate(PLENS):
+            reqs.append(eng.add_request(
+                r.integers(0, 97, (n,)), BUDGET,
+                on_token=bomb if i == 3 else None))
+        eng.run()
+        assert reqs[3].state == "FAILED"
+        assert reqs[3].failure_reason == "callback"
+        assert_healthy_match(reqs, clean, {3})
+
+
+class TestAllocatorGuards:
+    def test_free_slot_is_idempotent(self, gpt, rng):
+        """ISSUE 6 satellite: double-free must be a no-op — one slot
+        entry, no duplicated pages."""
+        eng = make_engine(gpt)
+        req = eng.add_request(rng.integers(0, 97, (9,)), 8)
+        eng._admit()
+        slot = req.slot
+        eng._active.pop(slot)
+        eng._free_slot(slot)
+        free_slots = list(eng._free_slots)
+        free_pages = list(eng._free_pages)
+        eng._free_slot(slot)  # double free
+        assert eng._free_slots == free_slots
+        assert eng._free_pages == free_pages
+        assert eng._free_slots.count(slot) == 1
+        assert len(set(eng._free_pages)) == len(eng._free_pages)
+
+    def test_trim_after_free_is_noop(self, gpt, rng):
+        eng = make_engine(gpt)
+        req = eng.add_request(rng.integers(0, 97, (9,)), 8)
+        eng._admit()
+        slot = req.slot
+        eng._active.pop(slot)
+        eng._free_slot(slot)
+        pages = list(eng._free_pages)
+        eng._trim_pages(slot, 0)  # free-after-free: nothing to return
+        assert eng._free_pages == pages
+
+    def test_spec_eos_mid_block_release_idempotent(self, gpt, rng):
+        """Regression for the spec-decode eos-mid-block path: the slot
+        frees the same step (engine + drafter sides), and a straggling
+        duplicate release must not corrupt either allocator."""
+        p = rng.integers(0, 97, (9,))
+        probe = make_engine(gpt)
+        cont = probe.add_request(p, 12)
+        probe.run()
+        eos = cont.tokens[5]
+        eng = make_engine(gpt, spec="ngram", spec_k=4, eos_id=eos)
+        req = eng.add_request(p, 12)
+        eng.run()
+        assert req.done and req.tokens[-1] == eos
+        slot_guess = 0
+        eng._free_slot(slot_guess)  # duplicate release after the fact
+        eng._spec.drafter.release(slot_guess)
+        assert len(eng._free_pages) == eng.num_pages - 1
+        assert len(set(eng._free_pages)) == len(eng._free_pages)
+        assert sorted(eng._free_slots) == list(range(eng.max_slots))
+        d = eng._spec.drafter
+        if hasattr(d, "_free_pages"):  # draft-LM drafter only
+            assert len(set(d._free_pages)) == len(d._free_pages)
+        d.reset()  # fault-contract: reset never raises, even stateless
+
+
+class TestFaultPlan:
+    def test_spec_parsing_and_semantics(self):
+        plan = FaultPlan("nan-logits:rid=2,times=1;slow-step:every=3")
+        assert plan.active("nan-logits") and plan.active("slow-step")
+        assert not plan.fire("nan-logits", rid=1)  # rid filter
+        assert plan.fire("nan-logits", rid=2)
+        assert not plan.fire("nan-logits", rid=2)  # times bound
+        fires = [plan.fire("slow-step") for _ in range(6)]
+        assert fires == [False, False, True, False, False, True]
+        assert plan.param("slow-step", "delay_ms", 20.0) == 20.0
+
+    def test_rate_is_deterministic_per_seed(self):
+        a = FaultPlan("step-exception:rate=0.5", seed=7)
+        b = FaultPlan("step-exception:rate=0.5", seed=7)
+        c = FaultPlan("step-exception:rate=0.5", seed=8)
+        fa = [a.fire("step-exception") for _ in range(64)]
+        fb = [b.fire("step-exception") for _ in range(64)]
+        fc = [c.fire("step-exception") for _ in range(64)]
+        assert fa == fb
+        assert fa != fc
+        assert 10 < sum(fa) < 54  # it is actually probabilistic
+
+    def test_unknown_point_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault-injection"):
+            FaultPlan("page-fire:every=1")
+        with pytest.raises(ValueError, match="malformed"):
+            FaultPlan("slow-step:delay_ms")
+
+    def test_flag_plumbing(self, gpt):
+        from paddle_tpu.framework import flags
+        from paddle_tpu.testing.faultinject import plan_from_flags
+
+        prev = flags.get_flags("FLAGS_fault_inject")["FLAGS_fault_inject"]
+        try:
+            flags.set_flags({"FLAGS_fault_inject": "slow-step:every=2"})
+            plan = plan_from_flags()
+            assert plan is not None and plan.active("slow-step")
+            eng = make_engine(gpt)  # engine picks the flag up by default
+            assert eng._fi is not None and eng._fi.active("slow-step")
+            flags.set_flags({"FLAGS_fault_inject": ""})
+            assert plan_from_flags() is None
+        finally:
+            flags.set_flags({"FLAGS_fault_inject": prev})
+
+
+class TestScrapeVisibility:
+    def test_failure_surface_is_scrape_visible(self, gpt, rng):
+        """Acceptance criterion: failures{reason}, admission rejections,
+        retries, recoveries, and the degraded-mode gauge all render via
+        the PR 3 Prometheus exporter."""
+        eng = make_engine(gpt, plan="nan-logits:rid=0,times=1")
+        req = eng.add_request(rng.integers(0, 97, (6,)), 6)
+        try:
+            eng.add_request(np.zeros((0,), np.int32), 4)
+        except ValidationError:
+            pass
+        eng.run()
+        assert req.failure_reason == "nan_logits"
+        text = render_prometheus()
+        assert 'paddle_tpu_request_failures_total{reason="nan_logits"}' \
+            in text
+        assert "paddle_tpu_admission_rejected_total" in text
+        assert "paddle_tpu_request_retries_total" in text
+        assert "paddle_tpu_engine_recoveries_total" in text
+        assert "paddle_tpu_engine_degraded" in text

@@ -341,7 +341,7 @@ class TestPreAdmission:
         eng._admit()
         assert eng._queue and eng._active  # the gate's real precondition
         got = eng._preadmit_dispatch(2)
-        assert got == ([], None, None)
+        assert got == ([], None, None, None)
         prompts = [rng.integers(0, 96, (n,)) for n in (5, 9, 7)]
         reqs = [eng.add_request(p, 10) for p in prompts]
         eng.run()

@@ -82,11 +82,11 @@ class TestFixtureExactness:
                 fam = RULES[v.rule].family
                 (by_family_sup if v.suppressed else by_family_live).add(fam)
         families = {r.family for r in RULES.values()}
-        assert len(families) >= 6
+        assert len(families) >= 7
         assert by_family_live == families
         # at least one demonstrated suppression per bucket we ship
         assert {"host-sync", "impure-random", "recompile", "side-effect",
-                "hygiene", "observability"} <= by_family_live
+                "hygiene", "observability", "error-handling"} <= by_family_live
 
     def test_suppression_reason_is_captured(self):
         got = lint_file(os.path.join(FIXTURES, "host_sync.py"))
@@ -99,7 +99,7 @@ class TestRegistry:
         assert set(RULES) == {
             "TPL101", "TPL102", "TPL201", "TPL301", "TPL302", "TPL303",
             "TPL304", "TPL401", "TPL402", "TPL501", "TPL502", "TPL503",
-            "TPL601",
+            "TPL601", "TPL701",
         }
         for r in RULES.values():
             assert r.description and r.name and r.family

@@ -6,8 +6,10 @@
 #           peak-memory liveness, collective/mesh consistency, donation,
 #           roofline cost over the real entry points. Traces tiny
 #           configs under JAX_PLATFORMS=cpu; gates `test` like lint.
-# chaos   — the fault-injection suite (ISSUE 6): every named injection
-#           point must isolate/retry/degrade, never crash Engine.step().
+# chaos   — the fault-injection suites: serving (ISSUE 6 — every named
+#           injection point must isolate/retry/degrade, never crash
+#           Engine.step()) and training (ISSUE 7 — kill/resume must be
+#           bit-identical, no fault can commit a torn checkpoint).
 #           CPU-safe, deterministic (seed-driven plans); gates `test`.
 # test    — the virtual-8-CPU-device suite (mesh/sharding logic, kernel
 #           math in interpret mode). Safe anywhere.
@@ -23,7 +25,8 @@ analyze:
 	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation
 
 chaos:
-	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py -q
+	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
+		tests/test_train_resilience.py -q
 
 test: lint analyze chaos
 	python -m pytest tests/ -x -q --ignore=tests/onchip

@@ -312,6 +312,123 @@ def bench_fault(cfg, on_tpu):
         return {"fault_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_resume(on_tpu):
+    """Training-resilience scenario (ISSUE 7): amortized per-step
+    checkpoint-save overhead through the raw train-step path — sync vs
+    async CheckpointManager.save at a production-shaped interval — and
+    resume-to-first-step latency (restore `latest` + one completed
+    step). Gate: async save overhead < 5% of baseline step time (lands
+    in BENCH_r07; the CPU smoke run is expected to warn — host compute
+    and the writer thread share the same cores there)."""
+    import shutil
+    import tempfile
+
+    try:
+        from paddle_tpu.distributed import CheckpointManager
+        from paddle_tpu.framework.tensor import Tensor
+        from paddle_tpu.jit import functional_call, param_arrays
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        if on_tpu:
+            cfg = GPTConfig(hidden_size=512, num_layers=8, num_heads=8,
+                            max_position=512, vocab_size=32000)
+            batch, seq, steps, every = 8, 512, 32, 16
+        else:
+            cfg = GPTConfig(hidden_size=128, num_layers=2, num_heads=4,
+                            max_position=256, vocab_size=1024)
+            batch, seq, steps, every = 2, 64, 16, 4
+
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        params = param_arrays(model)
+        names = [f"p{i:03d}" for i in range(
+            len(jax.tree_util.tree_leaves(params)))]
+        treedef = jax.tree_util.tree_structure(params)
+
+        def loss_fn(p, ids, labels):
+            logits = functional_call(model, p, Tensor._wrap(ids))
+            logz = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+            gold = jnp.take_along_axis(
+                logits, labels[..., None],
+                axis=-1)[..., 0].astype(jnp.float32)
+            return jnp.mean(logz - gold)
+
+        # NO buffer donation here on purpose: the checkpoint snapshot
+        # reads the params the step just produced
+        @jax.jit
+        def train_step(p, ids, labels):
+            loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+            return jax.tree_util.tree_map(
+                lambda a, g: a - 1e-4 * g, p, grads), loss
+
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                          jnp.int32)
+        labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                             jnp.int32)
+
+        def flat_state(p):
+            return dict(zip(names, jax.tree_util.tree_leaves(p)))
+
+        def run(n, saver=None, mgr=None):
+            p = params
+            t0 = time.perf_counter()
+            for i in range(n):
+                p, loss = train_step(p, ids, labels)
+                float(jax.device_get(loss))  # per-step fence
+                if saver is not None and (i + 1) % every == 0:
+                    saver(i + 1, flat_state(p))
+            if mgr is not None:
+                mgr.wait()  # trailing write counts against async too
+            return 1e3 * (time.perf_counter() - t0) / n
+
+        p_warm, l_warm = train_step(params, ids, labels)  # compile
+        float(jax.device_get(l_warm))
+        base_ms = run(steps)
+
+        root = tempfile.mkdtemp(prefix="bench_resume_")
+        try:
+            sync_dir, async_dir = f"{root}/sync", f"{root}/async"
+            mgr_s = CheckpointManager(sync_dir, keep_last_n=2)
+            sync_ms = run(steps, saver=mgr_s.save)
+            mgr_a = CheckpointManager(async_dir, keep_last_n=2,
+                                      async_save=True)
+            async_ms = run(steps, saver=mgr_a.save, mgr=mgr_a)
+
+            # resume-to-first-step latency: restore `latest`, rebuild the
+            # param tree, complete one step
+            t0 = time.perf_counter()
+            mgr_r = CheckpointManager(async_dir)
+            _, state = mgr_r.restore()
+            restored = jax.tree_util.tree_unflatten(
+                treedef, [state[n] for n in names])
+            p2, loss2 = train_step(restored, ids, labels)
+            float(jax.device_get(loss2))
+            resume_ms = 1e3 * (time.perf_counter() - t0)
+        finally:
+            shutil.rmtree(root, ignore_errors=True)
+
+        sync_frac = (sync_ms - base_ms) / base_ms
+        async_frac = (async_ms - base_ms) / base_ms
+        out = {
+            "resume_ckpt_every_steps": every,
+            "resume_step_ms_baseline": round(base_ms, 3),
+            "resume_step_ms_sync_ckpt": round(sync_ms, 3),
+            "resume_step_ms_async_ckpt": round(async_ms, 3),
+            "resume_sync_overhead_frac": round(sync_frac, 3),
+            "resume_async_overhead_frac": round(async_frac, 3),
+            "resume_async_overhead_ok": bool(async_frac < 0.05),
+            "resume_restore_ms": round(resume_ms, 3),
+        }
+        if not out["resume_async_overhead_ok"]:
+            print(f"WARNING: async checkpoint overhead "
+                  f"{async_frac:.1%} exceeds the 5% budget",
+                  file=sys.stderr)
+        return out
+    except Exception as e:
+        return {"resume_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def main():
     from paddle_tpu.framework.compile_cache import enable_compilation_cache
     from paddle_tpu.models.gpt import GPTConfig
@@ -353,6 +470,7 @@ def main():
     paged = bench_paged_decode(decode_cfg, on_tpu)
     spec = bench_spec(decode_cfg, on_tpu)
     fault = bench_fault(decode_cfg, on_tpu)
+    resume = bench_resume(on_tpu)
 
     # observability snapshot (ISSUE 3): the perf trajectory carries the
     # telemetry the run produced — how many programs compiled, whether
@@ -396,6 +514,18 @@ def main():
             metric_total("paddle_tpu_engine_recoveries_total")),
         "degraded_mode": int(
             metric_total("paddle_tpu_engine_degraded")),
+        # training-resilience surface (ISSUE 7): checkpoint commits and
+        # the in-loop guard counters as the registry saw them
+        "train_checkpoints": int(
+            metric_total("paddle_tpu_train_checkpoints_total")),
+        "train_step_retries": int(
+            metric_total("paddle_tpu_train_step_retries_total")),
+        "train_rollbacks": int(
+            metric_total("paddle_tpu_train_rollbacks_total")),
+        "train_preemptions": int(
+            metric_total("paddle_tpu_train_preemptions_total")),
+        "train_resumes": int(
+            metric_total("paddle_tpu_train_resumes_total")),
     }
 
     out = {
@@ -423,6 +553,7 @@ def main():
         **paged,
         **spec,
         **fault,
+        **resume,
         "metrics": metrics_block,
     }
     print(json.dumps(out))

@@ -772,8 +772,79 @@ class _ModuleAnalyzer:
                           "taxonomy (raise a paddle_tpu.inference.errors "
                           "type or call a *fail*/*fault* handler)")
 
+    # -- TPL702: direct writes to checkpoint paths -------------------------
+
+    _CKPT_PATH_HINTS = ("ckpt", "checkpoint", "step-")
+    _CKPT_SAFE_HINTS = ("tmp", "stage", "staging", "scratch", "trash")
+    _NP_SAVE_CALLS = {
+        "np.save", "numpy.save", "np.savez", "numpy.savez",
+        "np.savez_compressed", "numpy.savez_compressed",
+        "np.savetxt", "numpy.savetxt",
+    }
+
+    @staticmethod
+    def _path_expr_tokens(node) -> str:
+        """Identifiers, attribute names, and string literals in a path
+        expression, lowered and space-joined for substring hints."""
+        toks = []
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                toks.append(n.id)
+            elif isinstance(n, ast.Attribute):
+                toks.append(n.attr)
+            elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+                toks.append(n.value)
+        return " ".join(toks).lower()
+
+    def _ckpt_write_target(self, call: ast.Call):
+        """The path expression when ``call`` is a RAW file write:
+        ``open(path, 'w'/'wb'/'a'/'x')``, ``np.save*/np.savetxt(path,..)``,
+        or ``<path>.write_bytes/write_text(..)``; else None."""
+        fn = call.func
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            mode = None
+            if len(call.args) >= 2 and isinstance(call.args[1],
+                                                  ast.Constant):
+                mode = call.args[1].value
+            for kw in call.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+                    mode = kw.value.value
+            if isinstance(mode, str) and any(c in mode for c in "wax"):
+                return call.args[0] if call.args else None
+            return None
+        if _dotted(fn) in self._NP_SAVE_CALLS and call.args:
+            return call.args[0]
+        if isinstance(fn, ast.Attribute) and fn.attr in ("write_bytes",
+                                                         "write_text"):
+            return fn.value
+        return None
+
+    def _check_ckpt_writes(self):
+        """TPL702 — a raw write whose path expression names a checkpoint
+        ('ckpt'/'checkpoint'/'step-') bypasses the atomic-commit protocol
+        UNLESS it targets a staging path ('tmp'/'stage'/... in the
+        expression) — staging + rename IS the protocol, so the helper's
+        own writes and any compliant caller are exempt by construction."""
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            target = self._ckpt_write_target(n)
+            if target is None:
+                continue
+            toks = self._path_expr_tokens(target)
+            if not any(h in toks for h in self._CKPT_PATH_HINTS):
+                continue
+            if any(h in toks for h in self._CKPT_SAFE_HINTS):
+                continue
+            self._add(R.CKPT_WRITE_BYPASSES_COMMIT, n,
+                      "raw write to a checkpoint path bypasses the "
+                      "atomic-commit protocol; write via "
+                      "distributed.checkpoint/serialization.save, or "
+                      "stage ('tmp'/'stage' path) + os.replace")
+
     def _check_module_wide(self):
         self._check_error_handling()
+        self._check_ckpt_writes()
         # TPL304: module-bound donating wrappers are callable from any
         # function below, so function scopes inherit the module's set
         module_wrappers = self._collect_donating_wrappers(self.tree)

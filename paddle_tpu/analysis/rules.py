@@ -150,4 +150,15 @@ BROAD_EXCEPT_UNTYPED = _rule(
     "the failure from both the caller and the metrics.")
 
 
+CKPT_WRITE_BYPASSES_COMMIT = _rule(
+    "TPL702", "error-handling", "ckpt-write-bypasses-atomic-commit",
+    "direct file write (`open(..., 'w'/'wb')`, `np.save*`) to a checkpoint "
+    "path — an expression mentioning 'ckpt'/'checkpoint'/'step-' — outside "
+    "the atomic-commit protocol (ISSUE 7): a crash mid-write leaves a torn "
+    "file a reader can mistake for a committed checkpoint. Route the write "
+    "through `distributed.checkpoint.save_state_dict` / "
+    "`serialization.save`, or write into a staging path "
+    "('tmp'/'stage' in the name) and `os.replace` into place.")
+
+
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -53,6 +53,13 @@ from .spawn import spawn  # noqa: F401
 from . import launch  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .ckpt_manager import (  # noqa: F401
+    CheckpointManager,
+    PreemptionGuard,
+    TrainingPreempted,
+    pack_train_state,
+    unpack_train_state,
+)
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import (  # noqa: F401
     Partial,

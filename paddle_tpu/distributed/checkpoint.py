@@ -1,4 +1,4 @@
-"""Distributed (sharded, async) checkpointing.
+"""Distributed (sharded, async) checkpointing with ATOMIC commit.
 
 Reference gap being exceeded (SURVEY.md §5.4): upstream `paddle.save` is a
 single-process pickle (python/paddle/framework/io.py); distributed runs save
@@ -18,13 +18,37 @@ sharded + async checkpointing is table stakes, so this module provides:
   the returned :class:`AsyncSaveHandle` has ``wait()``/``done``. An
   in-flight save is joined before the next one starts (single-writer
   discipline, the orbax pattern).
+
+**Atomic commit protocol** (ISSUE 7): a checkpoint directory at its final
+path is COMPLETE by construction, so a preempted/killed writer can never
+leave a torn directory that a reader mistakes for a checkpoint:
+
+1. all files are written into a sibling *staging* directory
+   ``.tmp-<uuid>`` (multi-process runs converge on a deterministic
+   ``.tmp-shared-<name>`` so every rank stages into the same dir);
+2. every data file is flushed + fsynced; each process then writes its
+   ``metadata.p<idx>.json`` commit marker LAST (itself via tmp +
+   ``os.replace`` + fsync);
+3. whichever process observes all ``process_count`` markers fsyncs the
+   staging dir and renames it to the final path (dir rename is atomic on
+   POSIX), then fsyncs the parent.
+
+A crash at ANY point leaves either the previous committed checkpoint
+untouched plus an orphaned ``.tmp-*`` dir (reclaimed by
+:func:`gc_staging`), or the new checkpoint fully committed. The
+checkpoint-root helpers (:func:`list_steps` / :func:`latest_step` /
+:func:`write_manifest` / :func:`retain_last`) implement ``step-<N>``
+layout discovery, a root ``MANIFEST.json`` for external tooling, and
+keep-last-N retention on top of the same completeness predicate.
 """
 from __future__ import annotations
 
 import json
 import os
+import shutil
 import threading
-from typing import Any, Dict, List, Optional
+import uuid
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,7 +57,14 @@ import numpy as np
 from ..framework.tensor import Tensor
 
 __all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
-           "AsyncCheckpointer"]
+           "AsyncCheckpointer", "step_dir", "parse_step", "is_complete",
+           "list_steps", "latest_step", "write_manifest", "read_manifest",
+           "gc_staging", "retain_last", "STAGE_PREFIX", "MANIFEST_NAME"]
+
+STAGE_PREFIX = ".tmp-"
+TRASH_PREFIX = ".trash-"
+MANIFEST_NAME = "MANIFEST.json"
+_STEP_PREFIX = "step-"
 
 
 def _unwrap(v):
@@ -52,10 +83,39 @@ def _sanitize(name: str) -> str:
 
 def _jsonable(v):
     """Python-native scalars survive the JSON round-trip; numpy scalars are
-    converted (json.dump(default=str) would silently stringify them)."""
+    converted (json.dump(default=str) would silently stringify them).
+    Recurses into containers so e.g. an LR-scheduler state dict carrying
+    np.float64 entries round-trips instead of failing json.dump."""
     if isinstance(v, (np.generic,)):
         return v.item()
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_jsonable(x) for x in v]
     return v
+
+
+def _fsync_fileobj(f):
+    f.flush()
+    os.fsync(f.fileno())
+
+
+def _fsync_dir(path: str):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_json_atomic(data, path: str):
+    """tmp + fsync + os.replace: the file either has the old content or the
+    full new content, never a prefix."""
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+    with open(tmp, "w") as f:
+        json.dump(data, f)
+        _fsync_fileobj(f)
+    os.replace(tmp, path)
 
 
 def _collect_chunks(name: str, arr) -> List[Dict[str, Any]]:
@@ -80,21 +140,102 @@ def _collect_chunks(name: str, arr) -> List[Dict[str, Any]]:
     return chunks
 
 
+def _resolve_plan(fault_plan):
+    if fault_plan is not None:
+        from ..testing.faultinject import FaultPlan
+
+        return FaultPlan.from_spec(fault_plan)
+    try:
+        from ..testing.faultinject import plan_from_flags
+
+        return plan_from_flags()
+    except Exception:  # flags registry unavailable in stripped contexts
+        return None
+
+
+def _stage_path(final: str, pcount: int) -> str:
+    """Sibling staging dir. Single-process: fresh uuid per save (orphans
+    are GC'd, never resumed). Multi-process: every rank must stage into
+    the SAME dir with no side channel to agree on a uuid, so the name is
+    a deterministic function of the final path."""
+    final = os.path.abspath(final)
+    parent = os.path.dirname(final) or "."
+    base = os.path.basename(final)
+    if pcount > 1:
+        return os.path.join(parent, f"{STAGE_PREFIX}shared-{base}")
+    return os.path.join(parent, f"{STAGE_PREFIX}{uuid.uuid4().hex}")
+
+
+def _marker_count(path: str) -> int:
+    try:
+        return len([f for f in os.listdir(path)
+                    if f.startswith("metadata.p") and f.endswith(".json")])
+    except OSError:
+        return 0
+
+
+def is_complete(path: str) -> bool:
+    """The reader-side commit predicate: all per-process markers present
+    (the FIRST marker records the expected process_count)."""
+    import glob as _glob
+
+    markers = sorted(_glob.glob(os.path.join(path, "metadata.p*.json")))
+    if not markers:
+        return False
+    try:
+        with open(markers[0]) as f:
+            expect = int(json.load(f).get("process_count", 1))
+    except (OSError, ValueError):
+        return False
+    return len(markers) >= expect
+
+
+def _swap_into_place(stage: str, final: str):
+    """Atomically promote the complete staging dir to the final path.
+    Tolerates the multi-process race where a peer commits first."""
+    if os.path.exists(final):
+        trash = f"{final}{TRASH_PREFIX}{uuid.uuid4().hex[:8]}"
+        try:
+            os.rename(final, trash)
+        except OSError:
+            trash = None
+    else:
+        trash = None
+    try:
+        os.rename(stage, final)
+    except OSError:
+        # a peer process won the rename race; final must now be complete
+        if not is_complete(final):
+            raise
+    if trash is not None:
+        shutil.rmtree(trash, ignore_errors=True)
+    parent = os.path.dirname(os.path.abspath(final)) or "."
+    _fsync_dir(parent)
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     async_save: bool = False,
-                    process_index: Optional[int] = None):
-    """Write ``{name: Tensor|array}`` as a sharded checkpoint directory.
+                    process_index: Optional[int] = None,
+                    fault_plan=None,
+                    on_commit: Optional[Callable[[str], None]] = None):
+    """Write ``{name: Tensor|array}`` as a sharded checkpoint directory at
+    ``path`` via the atomic commit protocol (staging dir + fsync + rename;
+    see module docstring). ``path`` never holds a partial checkpoint.
 
-    Returns an :class:`AsyncSaveHandle` when ``async_save`` (already-complete
-    handle otherwise).
+    ``on_commit(path)`` runs in the writer (thread, when async) right
+    after the rename lands — the CheckpointManager hook for retention /
+    manifest updates. Returns an :class:`AsyncSaveHandle` when
+    ``async_save`` (already-complete handle otherwise).
     """
-    os.makedirs(path, exist_ok=True)
     pidx = jax.process_index() if process_index is None else process_index
     pcount = jax.process_count()
+    plan = _resolve_plan(fault_plan)
+    final = os.path.abspath(path)
+    stage = _stage_path(final, pcount)
 
     # snapshot to host NOW (async correctness: later mutations of the live
     # params must not leak into the checkpoint)
-    plan: List[Dict[str, Any]] = []
+    write_plan: List[Dict[str, Any]] = []
     meta: Dict[str, Any] = {"tensors": {}, "objects": {},
                             "format": "paddle_tpu.dist_ckpt.v1",
                             "process_index": pidx,
@@ -112,33 +253,51 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             entries.append({"offset": c["offset"],
                             "shape": list(c["data"].shape),
                             "file": fname})
-            plan.append({"file": os.path.join(path, fname),
-                         "data": c["data"]})
+            write_plan.append({"file": fname, "data": c["data"]})
         meta["tensors"][name] = {
             "global_shape": list(jarr.shape),
             "dtype": str(jarr.dtype),
             "chunks": entries,
         }
 
+    def _maybe_fault():
+        if plan is not None and plan.fire("ckpt-io-error"):
+            raise OSError("injected checkpoint I/O error (ckpt-io-error)")
+
     def _write():
-        for item in plan:
-            np.save(item["file"], item["data"], allow_pickle=False)
+        if plan is not None and plan.fire("slow-ckpt-write"):
+            import time as _time
+
+            _time.sleep(plan.param("slow-ckpt-write", "delay_ms", 20.0)
+                        / 1e3)
+        os.makedirs(stage, exist_ok=True)
+        for item in write_plan:
+            _maybe_fault()
+            with open(os.path.join(stage, item["file"]), "wb") as f:
+                np.save(f, item["data"], allow_pickle=False)
+                _fsync_fileobj(f)
         # per-process metadata written LAST = that process's commit marker;
-        # the checkpoint is complete when all process_count markers exist
+        # the staging dir is complete when all process_count markers exist
         # (multi-host: every process records only its addressable chunks;
         # the loader merges all metadata.p*.json)
-        with open(os.path.join(path, f"metadata.p{pidx}.json"), "w") as f:
-            json.dump(meta, f)
+        _maybe_fault()
+        _write_json_atomic(meta, os.path.join(stage,
+                                              f"metadata.p{pidx}.json"))
+        if _marker_count(stage) >= pcount:
+            _fsync_dir(stage)
+            _swap_into_place(stage, final)
+            if on_commit is not None:
+                on_commit(final)
 
     if async_save:
-        handle = AsyncSaveHandle(None)
+        handle = AsyncSaveHandle(None, path=final)
         t = threading.Thread(target=handle._run, args=(_write,),
                              daemon=True, name="ckpt-writer")
         handle._thread = t
         t.start()
         return handle
     _write()
-    return AsyncSaveHandle(None)
+    return AsyncSaveHandle(None, path=final)
 
 
 def load_state_dict(path: str, shardings: Optional[Dict[str, Any]] = None,
@@ -193,10 +352,138 @@ def load_state_dict(path: str, shardings: Optional[Dict[str, Any]] = None,
     return out
 
 
+# --------------------------------------------------------------------------
+# checkpoint-root layout: step-<N> dirs, MANIFEST.json, retention, GC
+# --------------------------------------------------------------------------
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, f"{_STEP_PREFIX}{int(step)}")
+
+
+def parse_step(name: str) -> Optional[int]:
+    base = os.path.basename(os.path.normpath(name))
+    if not base.startswith(_STEP_PREFIX):
+        return None
+    try:
+        return int(base[len(_STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def list_steps(root: str) -> List[int]:
+    """COMMITTED steps under ``root``, ascending. Completeness is
+    re-verified per dir (markers vs process_count) so a hand-truncated
+    dir is excluded, not just un-renamed staging."""
+    steps = []
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return []
+    for e in entries:
+        s = parse_step(e)
+        if s is not None and is_complete(os.path.join(root, e)):
+            steps.append(s)
+    return sorted(steps)
+
+
+def latest_step(root: str) -> Optional[int]:
+    """`latest` discovery: newest COMMITTED step (scan-based — the
+    manifest is advisory for external tools; the directory state is the
+    source of truth)."""
+    steps = list_steps(root)
+    return steps[-1] if steps else None
+
+
+def write_manifest(root: str) -> Dict[str, Any]:
+    """Atomically (re)write ``MANIFEST.json`` at the checkpoint root:
+    committed steps + latest pointer, for dashboards / fleet tooling that
+    should not have to know the completeness predicate."""
+    steps = list_steps(root)
+    data = {"format": "paddle_tpu.ckpt_root.v1",
+            "steps": steps,
+            "latest": steps[-1] if steps else None}
+    _write_json_atomic(data, os.path.join(root, MANIFEST_NAME))
+    return data
+
+
+def read_manifest(root: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(root, MANIFEST_NAME)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def gc_staging(root: str, in_flight: Optional[set] = None,
+               min_age_s: float = 0.0) -> List[str]:
+    """Remove orphaned ``.tmp-*`` staging and ``.trash-*`` dirs under
+    ``root`` (a previous writer died mid-save). ``in_flight`` paths are
+    spared (the manager's live async save), as is anything younger than
+    ``min_age_s`` — multi-process roots pass a stale threshold so one
+    rank's GC can never eat a PEER's staging dir mid-write."""
+    import time as _time
+
+    removed = []
+    in_flight = {os.path.abspath(p) for p in (in_flight or ())}
+    now = _time.time()
+    try:
+        entries = os.listdir(root)
+    except OSError:
+        return removed
+    for e in entries:
+        if not (e.startswith(STAGE_PREFIX) or TRASH_PREFIX in e
+                or e.startswith(TRASH_PREFIX)):
+            continue
+        full = os.path.abspath(os.path.join(root, e))
+        if full in in_flight or not os.path.isdir(full):
+            continue
+        if min_age_s > 0.0:
+            try:
+                if now - os.path.getmtime(full) < min_age_s:
+                    continue
+            except OSError:
+                continue
+        shutil.rmtree(full, ignore_errors=True)
+        removed.append(full)
+    return removed
+
+
+def retain_last(root: str, n: int) -> List[int]:
+    """Keep-last-N retention: delete committed ``step-*`` dirs beyond the
+    newest ``n`` (rename-to-trash first, so discovery never observes a
+    half-deleted checkpoint as committed). Returns the dropped steps."""
+    if n is None or n <= 0:
+        return []
+    steps = list_steps(root)
+    drop = steps[:-n] if len(steps) > n else []
+    for s in drop:
+        src = step_dir(root, s)
+        trash = f"{src}{TRASH_PREFIX}{uuid.uuid4().hex[:8]}"
+        try:
+            os.rename(src, trash)
+        except OSError:
+            continue
+        shutil.rmtree(trash, ignore_errors=True)
+    return drop
+
+
+# --------------------------------------------------------------------------
+# async handles
+# --------------------------------------------------------------------------
+
 class AsyncSaveHandle:
-    def __init__(self, thread: Optional[threading.Thread]):
+    """Handle for one background checkpoint write.
+
+    Failure contract (ISSUE 7 satellite): a writer exception is re-raised
+    by EVERY ``wait()`` call (not just the first), ``done`` only says the
+    attempt finished, and ``failed`` / ``exception()`` expose the outcome
+    so a poller never mistakes a failed write for a landed checkpoint."""
+
+    def __init__(self, thread: Optional[threading.Thread],
+                 path: Optional[str] = None):
         self._thread = thread
         self._error: Optional[BaseException] = None
+        self.path = path
 
     def _run(self, fn):
         try:
@@ -206,31 +493,61 @@ class AsyncSaveHandle:
 
     @property
     def done(self) -> bool:
+        """The write attempt is over (successfully or not)."""
         return self._thread is None or not self._thread.is_alive()
+
+    @property
+    def failed(self) -> bool:
+        """The write attempt finished AND raised — the checkpoint did not
+        commit."""
+        return self.done and self._error is not None
+
+    @property
+    def succeeded(self) -> bool:
+        return self.done and self._error is None
+
+    def exception(self) -> Optional[BaseException]:
+        """The writer's exception, without raising (None while running or
+        on success)."""
+        return self._error
 
     def wait(self):
         if self._thread is not None:
             self._thread.join()
         if self._error is not None:
-            err, self._error = self._error, None
-            raise RuntimeError("async checkpoint write failed") from err
+            # sticky: every wait() re-raises, so no call site can observe
+            # "second wait succeeded" after a failed write
+            raise RuntimeError(
+                "async checkpoint write failed") from self._error
 
 
 class AsyncCheckpointer:
     """Single-writer async checkpoint manager (orbax-style): a new save
-    joins the previous in-flight write first, so at most one background
-    writer exists and checkpoints land in order."""
+    JOINS the previous in-flight write first (thread-safe — concurrent
+    ``save()`` callers serialize on a lock), so at most one background
+    writer exists, writes to the same path never interleave, and
+    checkpoints land in order. A failed previous write is re-raised by
+    the next ``save()``/``wait()`` rather than silently dropped."""
 
     def __init__(self):
         self._inflight: Optional[AsyncSaveHandle] = None
+        self._lock = threading.Lock()
 
-    def save(self, state_dict, path) -> AsyncSaveHandle:
-        if self._inflight is not None:
-            self._inflight.wait()
-        self._inflight = save_state_dict(state_dict, path, async_save=True)
-        return self._inflight
+    def save(self, state_dict, path, fault_plan=None,
+             on_commit=None) -> AsyncSaveHandle:
+        with self._lock:
+            if self._inflight is not None:
+                prev, self._inflight = self._inflight, None
+                prev.wait()  # blocks; re-raises a failed previous write
+            self._inflight = save_state_dict(
+                state_dict, path, async_save=True, fault_plan=fault_plan,
+                on_commit=on_commit)
+            return self._inflight
 
     def wait(self):
-        if self._inflight is not None:
-            self._inflight.wait()
-            self._inflight = None
+        with self._lock:
+            if self._inflight is not None:
+                try:
+                    self._inflight.wait()
+                finally:
+                    self._inflight = None

@@ -121,3 +121,22 @@ def get_rng_state():
 def set_rng_state(st):
     with _lock:
         _state.update(st)
+
+
+def rng_state_snapshot() -> dict:
+    """Checkpoint-serializable global RNG state (ISSUE 7): the key is a
+    pure function of the seed (materialized lazily), so ``(seed,
+    counter)`` reproduces the stream exactly — no device array to save."""
+    with _lock:
+        return {"seed": int(_state["seed"]), "counter": int(_state["counter"])}
+
+
+def rng_state_restore(snap: dict):
+    """Restore a :func:`rng_state_snapshot`: the next ``next_key()`` /
+    ``op_key()`` after restore is bit-identical to the one the
+    interrupted run would have drawn. Stays backend-lazy (key=None), so
+    restoring before ``jax.distributed.initialize`` is safe."""
+    with _lock:
+        _state["seed"] = int(snap["seed"])
+        _state["counter"] = int(snap["counter"])
+        _state["key"] = None

@@ -109,7 +109,40 @@ class Model:
             epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
             save_dir=None, save_freq: int = 1, verbose: int = 2,
             drop_last: bool = False, shuffle: bool = True, num_workers: int = 0,
-            callbacks: Optional[List[Callback]] = None):
+            callbacks: Optional[List[Callback]] = None,
+            ckpt_dir: Optional[str] = None, ckpt_freq: Optional[int] = None,
+            resume=None, keep_last_n: int = 3, async_ckpt: bool = False,
+            grace_secs: float = 30.0, max_step_retries: int = 0,
+            retry_backoff: float = 0.1,
+            divergence_factor: Optional[float] = None,
+            fault_plan=None):
+        """Train. ISSUE 7 resilience surface (all opt-in via ``ckpt_dir``):
+
+        * ``ckpt_dir`` — CheckpointManager root: atomic ``step-<N>``
+          checkpoints of params + optimizer slots + global RNG position +
+          the (epoch, step) dataloader cursor, saved every ``ckpt_freq``
+          global steps (always at epoch end), ``keep_last_n`` retained,
+          written in the background when ``async_ckpt``.
+        * ``resume="auto"`` — restart from ``latest`` (no-op when the root
+          is empty); ``resume=<int>`` pins a step. A resumed run replays
+          the interrupted epoch's exact batch order (per-epoch seeded
+          shuffle) from the saved cursor, so loss curves and params are
+          bit-identical to an uninterrupted run.
+        * SIGTERM / ``preempt-signal`` — the in-flight step drains, a
+          final checkpoint force-commits synchronously (warned when it
+          blows ``grace_secs``), then :class:`TrainingPreempted` is
+          raised carrying the committed step.
+        * ``max_step_retries`` — transient step faults retry with
+          exponential backoff (grads cleared between attempts).
+        * divergence guard — a NaN/inf loss (or, with
+          ``divergence_factor``, a loss above ``factor×EMA``) rolls back
+          to the last-good checkpoint and skips the offending batch.
+        """
+        import math
+        import time as _time
+
+        from ..testing.faultinject import FaultPlan, plan_from_flags
+
         loader = self._as_loader(train_data, batch_size, shuffle, drop_last,
                                  num_workers)
         eval_loader = (self._as_loader(eval_data, batch_size, False, False,
@@ -122,18 +155,85 @@ class Model:
         cbs.set_params({"epochs": epochs, "verbose": verbose})
         self.stop_training = False
 
+        plan = (FaultPlan.from_spec(fault_plan) if fault_plan is not None
+                else plan_from_flags())
+        manager = None
+        if ckpt_dir is not None:
+            from ..distributed.ckpt_manager import CheckpointManager
+
+            manager = CheckpointManager(ckpt_dir, keep_last_n=keep_last_n,
+                                        async_save=async_ckpt,
+                                        fault_plan=plan)
+        start_epoch = start_step = global_step = 0
+        last_saved = None
+        if resume is not None and manager is not None:
+            restored = self._restore_for_resume(manager, resume)
+            if restored is not None:
+                start_epoch, start_step, global_step = restored
+                last_saved = global_step
+                self._train_metric("paddle_tpu_train_resumes_total",
+                                   "exact-resume restarts from a "
+                                   "committed checkpoint")
+                if verbose:
+                    print(f"resuming from step-{global_step} "
+                          f"(epoch {start_epoch}, batch {start_step})")
+
+        from ..distributed.ckpt_manager import (PreemptionGuard,
+                                                TrainingPreempted)
+
+        loss_ema = None
         cbs.on_train_begin()
         history = {"loss": []}
+        # entered manually so the epoch loop keeps its indentation; the
+        # finally below restores the previous SIGTERM handler either way
+        guard = PreemptionGuard()
+        guard.__enter__()
         try:
-            for epoch in range(epochs):
+            for epoch in range(start_epoch, epochs):
+                if manager is not None:
+                    self._seed_loader_epoch(loader, epoch)
+                skip = start_step if epoch == start_epoch else 0
                 cbs.on_epoch_begin(epoch)
                 epoch_losses = []
                 for step, batch in enumerate(loader):
+                    if step < skip:  # fast-forward to the saved cursor
+                        continue
                     cbs.on_train_batch_begin(step)
                     ins, labels = self._split_batch(batch)
-                    losses = self.train_batch(ins, labels)
-                    epoch_losses.append(losses[0])
-                    cbs.on_train_batch_end(step, {"loss": losses[0]})
+                    losses = self._guarded_train_batch(
+                        ins, labels, plan, max_step_retries, retry_backoff)
+                    loss0 = losses[0]
+                    if plan is not None and plan.fire("train-nan-loss"):
+                        loss0 = float("nan")
+                    guard_on = (manager is not None
+                                or divergence_factor is not None)
+                    spiked = (divergence_factor is not None
+                              and loss_ema is not None
+                              and loss0 > divergence_factor
+                              * max(abs(loss_ema), 1e-8))
+                    if guard_on and (not math.isfinite(loss0) or spiked):
+                        self._rollback_to_last_good(manager, verbose,
+                                                    loss0, epoch, step)
+                        continue  # the offending batch is skipped
+                    loss_ema = (loss0 if loss_ema is None
+                                else 0.9 * loss_ema + 0.1 * loss0)
+                    global_step += 1
+                    epoch_losses.append(loss0)
+                    cbs.on_train_batch_end(step, {"loss": loss0})
+                    if (manager is not None and ckpt_freq
+                            and global_step % ckpt_freq == 0):
+                        manager.save(global_step, self._snapshot_train_state(
+                            epoch, step + 1, global_step))
+                        last_saved = global_step
+                    if guard.preempted or (plan is not None
+                                           and plan.fire("preempt-signal")):
+                        ck_path = self._drain_and_commit(
+                            manager, epoch, step + 1, global_step,
+                            grace_secs, _time, verbose)
+                        raise TrainingPreempted(
+                            f"preempted at global step {global_step}; "
+                            f"checkpoint {'committed' if ck_path else 'skipped (no ckpt_dir)'}",
+                            step=global_step, checkpoint_path=ck_path)
                     if self.stop_training:
                         break
                 logs = {"loss": float(np.mean(epoch_losses))
@@ -146,6 +246,13 @@ class Model:
                 cbs.on_epoch_end(epoch, logs)
                 if save_dir and (epoch % save_freq == 0):
                     self.save(os.path.join(save_dir, str(epoch)))
+                if manager is not None and last_saved != global_step:
+                    # epoch-boundary checkpoint: cursor points at the next
+                    # epoch's first batch
+                    manager.save(global_step, self._snapshot_train_state(
+                        epoch + 1, 0, global_step))
+                    last_saved = global_step
+                start_step = 0
                 if self.stop_training:
                     break
         except BaseException:
@@ -163,6 +270,10 @@ class Model:
                         except Exception:
                             pass  # best-effort: never mask the real error
             raise
+        finally:
+            guard.__exit__(None, None, None)
+        if manager is not None:
+            manager.wait()  # surface a failed trailing async write
         cbs.on_train_end()
         if save_dir:
             self.save(os.path.join(save_dir, "final"))
@@ -240,6 +351,160 @@ class Model:
         s = "\n".join(lines)
         print(s)
         return {"total_params": total}
+
+    # ------------------------------------------------- resilience helpers
+    def _guarded_train_batch(self, ins, labels, plan, max_retries, backoff):
+        """One train step under the transient-fault contract: the
+        ``train-step-exception`` hook fires BEFORE compute (a dispatch
+        fault), and any step exception is retried up to ``max_retries``
+        times with exponential backoff, clearing accumulated grads so a
+        half-run backward can't double-count."""
+        import time as _time
+
+        from ..testing.faultinject import InjectedFault
+
+        attempt = 0
+        while True:
+            try:
+                if plan is not None and plan.fire("train-step-exception"):
+                    raise InjectedFault("injected train-step exception")
+                return self.train_batch(ins, labels)
+            except Exception:
+                if attempt >= max_retries:
+                    raise
+                attempt += 1
+                self._train_metric(
+                    "paddle_tpu_train_step_retries_total",
+                    "transient train-step faults retried with backoff")
+                if self._optimizer is not None:
+                    self._optimizer.clear_grad()
+                _time.sleep(backoff * (2 ** (attempt - 1)))
+
+    def _snapshot_train_state(self, epoch, next_step, global_step):
+        """The full resume closure at a step boundary: params, optimizer
+        slots, global RNG position, and the dataloader cursor (epoch +
+        next batch index within it)."""
+        from ..distributed.ckpt_manager import pack_train_state
+
+        opt_sd = (self._optimizer.state_dict()
+                  if self._optimizer is not None
+                  and hasattr(self._optimizer, "state_dict") else None)
+        return pack_train_state(self.network.state_dict(), opt_sd,
+                                epoch=int(epoch), step=int(next_step),
+                                global_step=int(global_step))
+
+    def _restore_train_state(self, unpacked):
+        """Params + optimizer + RNG from an unpacked checkpoint (the
+        progress cursor is the caller's concern)."""
+        from ..framework import random as _random
+
+        if unpacked["model"]:
+            self.network.set_state_dict(unpacked["model"])
+        if (unpacked["optimizer"] and self._optimizer is not None
+                and hasattr(self._optimizer, "set_state_dict")):
+            self._optimizer.set_state_dict(unpacked["optimizer"])
+        if unpacked["rng"]:
+            _random.rng_state_restore(unpacked["rng"])
+
+    def _restore_for_resume(self, manager, resume):
+        """Resolve ``resume=`` against the checkpoint root; returns the
+        (epoch, step, global_step) cursor or None for a fresh start."""
+        from ..distributed.ckpt_manager import unpack_train_state
+
+        # identity check: resume=1 means step 1, not auto (1 == True!)
+        target = None if (resume is True or resume == "auto") else int(resume)
+        try:
+            ck_step, state = manager.restore(step=target)
+        except FileNotFoundError:
+            if target is not None:
+                raise
+            return None  # resume="auto" on an empty root: fresh run
+        u = unpack_train_state(state)
+        self._restore_train_state(u)
+        prog = u["progress"]
+        return (int(prog.get("epoch", 0)), int(prog.get("step", 0)),
+                int(prog.get("global_step", ck_step)))
+
+    def _seed_loader_epoch(self, loader, epoch):
+        """Pin the epoch's batch order to a deterministic function of
+        (global seed, epoch) so an interrupted epoch replays identically
+        on resume. Respects a user-pinned sampler generator."""
+        from ..framework import random as _random
+
+        bs = getattr(loader, "batch_sampler", None)
+        if bs is None:
+            return
+        if hasattr(bs, "set_epoch"):
+            try:
+                bs.set_epoch(epoch)
+            except Exception:
+                pass
+        sampler = getattr(bs, "sampler", None)
+        if sampler is None:
+            return
+        # seed when unpinned, and RE-seed every epoch once we own the
+        # generator — otherwise epoch N>0 silently replays epoch 0's
+        # permutation in a fresh process but not in a resumed one
+        owned = getattr(sampler, "_pt_fit_seeded", False)
+        if owned or getattr(sampler, "generator", "absent") is None:
+            sampler.generator = (
+                _random.get_seed() * 1000003 + 7919 * epoch + 1) & 0x7FFFFFFF
+            sampler._pt_fit_seeded = True
+
+    def _rollback_to_last_good(self, manager, verbose, loss, epoch, step):
+        """Divergence guard: restore the last-good committed checkpoint
+        (params/opt/RNG — the cursor keeps advancing so the offending
+        batch is skipped) and count the rollback."""
+        from ..distributed.ckpt_manager import unpack_train_state
+
+        self._train_metric(
+            "paddle_tpu_train_rollbacks_total",
+            "divergence-guard rollbacks to the last-good checkpoint")
+        if verbose:
+            print(f"divergence guard: loss={loss} at epoch {epoch} "
+                  f"step {step}; rolling back and skipping the batch")
+        if self._optimizer is not None:
+            self._optimizer.clear_grad()
+        if manager is None or manager.latest_step() is None:
+            return  # nothing committed yet: skip the batch only
+        manager.wait()  # join an in-flight async write first
+        _, state = manager.restore()
+        self._restore_train_state(unpack_train_state(state))
+
+    def _drain_and_commit(self, manager, epoch, next_step, global_step,
+                          grace_secs, _time, verbose):
+        """Preemption drain: the current step has completed; force-commit
+        a final checkpoint SYNCHRONOUSLY (the process is about to die)
+        and warn when the commit blows the grace budget."""
+        import warnings
+
+        self._train_metric("paddle_tpu_train_preemptions_total",
+                           "preemption signals drained by the train loop")
+        if manager is None:
+            return None
+        t0 = _time.perf_counter()
+        manager.save(global_step,
+                     self._snapshot_train_state(epoch, next_step,
+                                                global_step),
+                     sync=True)
+        manager.wait()
+        took = _time.perf_counter() - t0
+        if took > grace_secs:
+            warnings.warn(
+                f"preemption checkpoint commit took {took:.1f}s, over the "
+                f"{grace_secs:.1f}s grace budget — consider async_ckpt or "
+                "a larger ckpt_freq")
+        elif verbose:
+            print(f"preempted: committed step-{global_step} in {took:.2f}s")
+        return manager.step_path(global_step)
+
+    @staticmethod
+    def _train_metric(name, help_text):
+        try:
+            from ..observability import counter
+        except Exception:  # pragma: no cover - stripped contexts
+            return
+        counter(name, help_text).inc()
 
     # -------------------------------------------------------------- helpers
     def _as_loader(self, data, batch_size, shuffle, drop_last, num_workers):

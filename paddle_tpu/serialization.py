@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import os
 import pickle
+import uuid
 from typing import Any
 
 import numpy as np
@@ -61,8 +62,19 @@ def save(obj: Any, path: str, protocol: int = 4):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+    # atomic single-file save (ISSUE 7 satellite): stage into a sibling
+    # tmp file, fsync, then os.replace — a crash mid-write leaves either
+    # the old file or the new one, never a torn pickle.
+    tmp = f"{path}.tmp-{uuid.uuid4().hex[:8]}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_pack(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load(path: str, return_numpy: bool = False):

@@ -25,6 +25,23 @@ Injection points (the engine's hook sites; see README "Failure semantics"):
 * ``slow-step``        — sleeps ``delay_ms`` at the top of ``step()``,
   driving deadline/TTL expiry deterministically.
 
+Training points (ISSUE 7 — consulted by ``distributed/checkpoint.py``,
+``distributed/ckpt_manager.py`` and the ``hapi.Model.fit`` train loop):
+
+* ``ckpt-io-error``    — the checkpoint writer raises ``OSError`` before a
+  staging-file write, leaving a TORN ``.tmp-*`` dir; the committed
+  checkpoint at the final path must be unaffected (atomic-commit proof).
+* ``slow-ckpt-write``  — sleeps ``delay_ms`` at the top of the checkpoint
+  writer, driving async-overlap and preemption-grace-budget paths.
+* ``train-step-exception`` — raises ``InjectedFault`` at the top of one
+  training step (a transient dispatch fault), driving the bounded
+  retry-with-backoff path.
+* ``train-nan-loss``   — forces the step's scalar loss to NaN, driving the
+  divergence guard's rollback-to-last-good + skip-batch path.
+* ``preempt-signal``   — trips the preemption flag at a step boundary, as
+  if SIGTERM had arrived: the loop drains the step, force-commits a final
+  checkpoint, and raises ``TrainingPreempted``.
+
 Spec grammar (``FLAGS_fault_inject`` / env ``PADDLE_TPU_FAULT_INJECT`` /
 ``Engine(fault_plan=...)``)::
 
@@ -65,6 +82,12 @@ POINTS = (
     "nan-logits",
     "drafter-corruption",
     "slow-step",
+    # training-resilience points (ISSUE 7)
+    "ckpt-io-error",
+    "train-step-exception",
+    "train-nan-loss",
+    "preempt-signal",
+    "slow-ckpt-write",
 )
 
 

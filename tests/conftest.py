@@ -55,6 +55,11 @@ def pytest_configure(config):
         "timeout(seconds): fail the test if it runs longer than the "
         "given wall-clock seconds (SIGALRM-based; vendored stand-in for "
         "pytest-timeout)")
+    config.addinivalue_line(
+        "markers",
+        "multihost: true multi-process test (subprocess workers rendezvous "
+        "through jax.distributed); skips itself on the jaxlib-0.4.37 CPU "
+        "backend's exact no-multiprocess-computations signature")
 
 
 def _timeout_guard(item):

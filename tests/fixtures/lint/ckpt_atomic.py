@@ -1,0 +1,57 @@
+"""TPL702 fixtures — checkpoint writes must go through the atomic-commit
+protocol (ISSUE 7): raw writes to checkpoint paths can be torn by a crash
+and read back as a complete-but-corrupt checkpoint. Compliant code writes
+into a staging path ('tmp'/'stage' in the expression) and renames, or uses
+the distributed.checkpoint / serialization helpers."""
+import json
+import os
+
+import numpy as np
+
+
+def bad_direct_chunk(ckpt_dir, arr):
+    np.save(os.path.join(ckpt_dir, "w.npy"), arr)  # EXPECT: TPL702
+
+
+def bad_marker_write(checkpoint_root, meta):
+    with open(os.path.join(checkpoint_root, "metadata.json"), "w") as f:  # EXPECT: TPL702
+        json.dump(meta, f)
+
+
+def bad_literal_step_dir(root, payload):
+    with open(root + "/step-10/extra.bin", "wb") as f:  # EXPECT: TPL702
+        f.write(payload)
+
+
+def bad_pathlib_write(ckpt_path, payload):
+    ckpt_path.write_bytes(payload)  # EXPECT: TPL702
+
+
+def good_staged_chunk(ckpt_stage_dir, arr):
+    # staging-dir write + (elsewhere) os.replace — the protocol itself
+    np.save(os.path.join(ckpt_stage_dir, "w.npy"), arr)
+
+
+def good_tmp_then_replace(ckpt_dir, meta):
+    tmp = os.path.join(ckpt_dir, ".manifest.tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f)
+    os.replace(tmp, os.path.join(ckpt_dir, "MANIFEST.json"))
+
+
+def good_helper(state, ckpt_dir):
+    from paddle_tpu.distributed import save_state_dict
+
+    save_state_dict(state, os.path.join(ckpt_dir, "step-1"))
+
+
+def good_read_side(ckpt_dir):
+    with open(os.path.join(ckpt_dir, "MANIFEST.json")) as f:
+        return f.read()
+
+
+def suppressed_legacy_export(ckpt_dir, arr):
+    # tpulint: disable=TPL702 -- fixture: demonstrates a justified
+    # suppression (a read-only debug dump consumed by a human, never by
+    # load_state_dict, so torn output cannot be mistaken for a checkpoint)
+    np.save(os.path.join(ckpt_dir, "debug_dump.npy"), arr)  # EXPECT-SUPPRESSED: TPL702

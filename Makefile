@@ -26,7 +26,7 @@ analyze:
 
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
-		tests/test_train_resilience.py -q
+		tests/test_train_resilience.py tests/test_prefix_cache.py -q
 
 test: lint analyze chaos
 	python -m pytest tests/ -x -q --ignore=tests/onchip

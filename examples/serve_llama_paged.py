@@ -47,6 +47,12 @@ def main():
                     help="max draft tokens per verify step (the verify "
                          "block scores k+1 positions in one forward); "
                          "per-request depth adapts to an acceptance EMA")
+    ap.add_argument("--prefix-cache", choices=["on", "off"], default="on",
+                    help="refcounted copy-on-write prefix caching "
+                         "(ISSUE 8): admissions splice cached "
+                         "block-aligned prompt prefixes into their page "
+                         "table and prefill only the uncached suffix; "
+                         "output tokens are identical either way")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request TTL (ISSUE 6): a request that "
                          "hasn't finished this many ms after submission "
@@ -127,7 +133,8 @@ def main():
                  deadline_s=(args.deadline_ms / 1e3
                              if args.deadline_ms is not None else None),
                  max_queue=args.max_queue,
-                 fault_plan=args.fault_inject)
+                 fault_plan=args.fault_inject,
+                 prefix_cache=args.prefix_cache == "on")
     rng = np.random.default_rng(0)
 
     # mixed-length requests, more requests than slots: admission interleaves
@@ -160,8 +167,15 @@ def main():
             continue
         print(f"request {r.rid}: prompt {r.prompt.size:>2} -> "
               f"{len(r.tokens)} tokens (streamed {len(streams[i])})")
-    print(f"pool fully recycled: {len(eng._free_pages)}/{free0} free "
-          f"(int8_cache={args.int8_cache})")
+    # cached-idle pages are resident on purpose (refcount 0, LRU-evictable
+    # the moment an allocation needs them) — they count as recycled
+    resident = eng._pcache.n_pages if eng._pcache is not None else 0
+    print(f"pool fully recycled: {len(eng._free_pages)}+{resident} cached "
+          f"of {free0} (int8_cache={args.int8_cache})")
+    if eng._pcache is not None:
+        pc = eng._pcache
+        print(f"prefix cache: {pc.hits} hits / {pc.misses} misses, "
+              f"{pc.n_pages} pages resident, {pc.evictions} evictions")
     if eng._spec is not None:
         s = eng._spec.stats()
         print(f"spec[{s['drafter']}] k={s['k']}: "

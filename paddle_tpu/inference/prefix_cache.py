@@ -1,0 +1,226 @@
+"""Refcounted prefix cache for the paged serving engine (ISSUE 8 tentpole).
+
+Production serving traffic is dominated by shared system prompts and
+few-shot templates, so most prefill FLOPs recompute KV the pool already
+holds. This module supplies the host-side index that turns a repeated
+prefix into a page-table splice:
+
+* **Chain hashing, page granularity.** A prefix is addressed block by
+  block: block ``i``'s key is ``blake2b(parent_key || tokens_i)``, so a
+  key commits to the ENTIRE token prefix up to and including its block —
+  two prompts sharing only a suffix can never alias. Only FULL blocks
+  (``page_size`` tokens) are cached; the partial tail page of a prompt is
+  always recomputed (vLLM-style block hashing; SGLang's radix tree is the
+  same reachability structure with keys instead of an explicit trie).
+* **Hash-verify-on-hit.** Every entry stores its block's actual tokens and
+  a lookup re-compares them, so even a blake2b collision (or a bug that
+  mis-registered an entry) degrades to a cache miss, never to serving the
+  wrong prefix.
+* **Refcounts live with the OWNER.** The cache never owns pages: the
+  engine's allocator keeps one refcount per physical page counting slot /
+  pre-admission-row references, and the cache is an index over pages whose
+  content is known. A page referenced only by the cache has refcount 0 —
+  resident but idle — and is exactly what ``evict_lru`` reclaims under
+  pool pressure. Pages with refcount > 0 are NEVER eviction candidates.
+* **Leaf-first LRU eviction.** Evicting an interior block would strand its
+  descendants (a lookup walks from the root, so an unreachable child can
+  never be spliced again yet would pin its page); ``evict_lru`` therefore
+  only considers entries with no cached children, oldest stamp first.
+  Lookups re-stamp the whole matched chain, so ancestors are always at
+  least as recent as their children and stale chains unwind tail-first.
+* **Invalidate-on-doubt.** ``invalidate_page`` drops the entry backing a
+  page AND every descendant (they are unreachable without the parent), so
+  any corruption signal — the ``prefix-cache-corruption`` fault point, a
+  failed integrity probe — costs future lookups a miss instead of wrong
+  tokens. ``clear`` is the pool-reset flush (engine fault recovery must
+  never serve pages whose backing buffers were rebuilt).
+
+The class is pure host code (stdlib + numpy) and deliberately knows
+nothing about jax, devices, or the engine: the engine (and the draft-LM
+drafter, which runs the same machinery over its own pool) passes its
+refcount array in where reclamation decisions need it.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+
+class _Entry:
+    """One cached full block: a physical page plus the chain identity."""
+
+    __slots__ = ("key", "page", "tokens", "parent", "children", "stamp")
+
+    def __init__(self, key: bytes, page: int, tokens: np.ndarray,
+                 parent: Optional[bytes], stamp: int):
+        self.key = key
+        self.page = int(page)
+        self.tokens = tokens          # this block's page_size tokens
+        self.parent = parent          # parent block's key (None at root)
+        self.children: set = set()    # keys of cached child blocks
+        self.stamp = stamp            # LRU clock at last touch
+
+
+class PrefixCache:
+    """Block-chain index from token prefixes to resident physical pages."""
+
+    def __init__(self, page_size: int):
+        self.page_size = int(page_size)
+        self._by_key: Dict[bytes, _Entry] = {}
+        self._by_page: Dict[int, _Entry] = {}
+        self._clock = 0
+        # plain-int telemetry the owner mirrors into its metrics registry
+        self.hits = 0        # lookups that matched >= 1 block
+        self.misses = 0      # lookups that matched nothing
+        self.evictions = 0   # pages reclaimed by evict_lru
+
+    # ------------------------------------------------------------- keys
+    def _chain(self, tokens: np.ndarray) -> List[Tuple[bytes, np.ndarray]]:
+        """(key, block_tokens) for every FULL block of ``tokens``."""
+        ps = self.page_size
+        toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+        out = []
+        parent = b""
+        for i in range(toks.size // ps):
+            block = toks[i * ps:(i + 1) * ps]
+            key = hashlib.blake2b(parent + block.tobytes(),
+                                  digest_size=16).digest()
+            out.append((key, block))
+            parent = key
+        return out
+
+    # ----------------------------------------------------------- lookup
+    def lookup(self, tokens, touch: bool = True
+               ) -> Tuple[List[int], int]:
+        """Longest cached block-aligned prefix of ``tokens``. Returns
+        ``(pages, matched_len)`` — ``matched_len`` is a multiple of
+        ``page_size`` and ``pages`` the physical pages backing it, in
+        block order. ``touch=False`` is a pure peek (capacity planning):
+        no LRU re-stamp, no hit/miss accounting."""
+        pages: List[int] = []
+        matched = 0
+        chain: List[_Entry] = []
+        for key, block in self._chain(tokens):
+            ent = self._by_key.get(key)
+            if ent is None or not np.array_equal(ent.tokens, block):
+                # missing, or a hash collision / stale entry caught by the
+                # verify-on-hit token compare: stop at a miss
+                break
+            chain.append(ent)
+            pages.append(ent.page)
+            matched += self.page_size
+        if touch:
+            if chain:
+                self._clock += 1
+                for ent in chain:
+                    ent.stamp = self._clock
+                self.hits += 1
+            else:
+                self.misses += 1
+        return pages, matched
+
+    # --------------------------------------------------------- register
+    def register(self, tokens, pages) -> int:
+        """Publish the full blocks of ``tokens`` as backed by ``pages``
+        (one physical page per block, block order). Existing entries win
+        — a block already cached keeps its original page and the caller's
+        page stays private (first-writer-wins dedup, so one content hash
+        never maps to two pages). Returns the number of pages adopted."""
+        adopted = 0
+        self._clock += 1
+        parent_ent: Optional[_Entry] = None
+        for (key, block), page in zip(self._chain(tokens), pages):
+            page = int(page)
+            ent = self._by_key.get(key)
+            if ent is not None:
+                # verify-on-hit also guards registration: a colliding key
+                # with different tokens must not chain through
+                if not np.array_equal(ent.tokens, block):
+                    break
+                ent.stamp = self._clock
+                parent_ent = ent
+                continue
+            if page <= 0 or page in self._by_page:
+                # page 0 is the engine's trash page; a page can only back
+                # one block's content
+                break
+            ent = _Entry(key, page, np.array(block, np.int32),
+                         parent_ent.key if parent_ent is not None else None,
+                         self._clock)
+            self._by_key[key] = ent
+            self._by_page[page] = ent
+            if parent_ent is not None:
+                parent_ent.children.add(key)
+            parent_ent = ent
+            adopted += 1
+        return adopted
+
+    # ---------------------------------------------------------- queries
+    @property
+    def n_pages(self) -> int:
+        return len(self._by_page)
+
+    def contains_page(self, page: int) -> bool:
+        return int(page) in self._by_page
+
+    def evictable_count(self, page_ref) -> int:
+        """Upper bound on reclaimable pages: entries whose page has no
+        live references. (An interior refcount-0 block above a pinned
+        descendant is counted but not yet evictable — the shortfall
+        surfaces as an allocation failure the caller already handles.)"""
+        return sum(1 for p in self._by_page if not page_ref[p])
+
+    # ---------------------------------------------------------- removal
+    def _remove(self, ent: _Entry):
+        del self._by_key[ent.key]
+        self._by_page.pop(ent.page, None)
+        if ent.parent is not None:
+            parent = self._by_key.get(ent.parent)
+            if parent is not None:
+                parent.children.discard(ent.key)
+
+    def evict_lru(self, page_ref) -> Optional[int]:
+        """Reclaim ONE idle page: the oldest-stamped LEAF entry whose page
+        has refcount 0. Returns the freed page id, or None when every
+        cached page is either referenced or an interior block. Never
+        touches a page any slot still references."""
+        victim = None
+        for ent in self._by_key.values():
+            if ent.children or page_ref[ent.page]:
+                continue
+            if victim is None or ent.stamp < victim.stamp:
+                victim = ent
+        if victim is None:
+            return None
+        self._remove(victim)
+        self.evictions += 1
+        return victim.page
+
+    def invalidate_page(self, page: int) -> List[int]:
+        """Drop the entry backing ``page`` and every descendant block
+        (unreachable without their parent). Returns the pages whose
+        entries were dropped — the owner routes each by refcount (0 →
+        free list, >0 → returns on release as usual)."""
+        ent = self._by_page.get(int(page))
+        if ent is None:
+            return []
+        stack, dropped = [ent], []
+        while stack:
+            e = stack.pop()
+            stack.extend(self._by_key[k] for k in e.children
+                         if k in self._by_key)
+            self._remove(e)
+            dropped.append(e.page)
+        return dropped
+
+    def clear(self) -> List[int]:
+        """Flush everything (pool reset / fault recovery). Returns the
+        previously cached pages."""
+        pages = list(self._by_page)
+        self._by_key.clear()
+        self._by_page.clear()
+        return pages

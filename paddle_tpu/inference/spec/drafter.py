@@ -135,6 +135,18 @@ class DraftModelDrafter:
                                np.int32)
         self.lengths = np.zeros((engine.max_slots,), np.int32)
         self._free_pages = list(range(self.num_pages - 1, 0, -1))
+        # prefix caching (ISSUE 8): the drafter runs the engine's
+        # refcount+cache machinery over its OWN pool (draft-model KV is
+        # different content, so it needs its own index), enabled iff the
+        # engine's cache is — a re-prefill after preemption/slot reuse
+        # then splices cached draft pages instead of recomputing them
+        self._page_ref = np.zeros((self.num_pages,), np.int32)
+        if getattr(engine, "_pcache", None) is not None:
+            from ..prefix_cache import PrefixCache
+
+            self._pcache = PrefixCache(self.page_size)
+        else:
+            self._pcache = None
         self._slot_rid = np.full((engine.max_slots,), -1, np.int64)
         self._last = np.zeros((engine.max_slots,), np.int32)
         self._swap = [p for _, p in model.named_parameters()]
@@ -147,30 +159,60 @@ class DraftModelDrafter:
     def _pages_needed(self, length):
         return (int(length) + self.page_size - 1) // self.page_size
 
+    def _alloc_page(self):
+        """Free list first, then LRU-evict an idle cached draft page —
+        the drafter twin of ``Engine._alloc_page``."""
+        if self._free_pages:
+            page = self._free_pages.pop()
+        elif self._pcache is not None:
+            page = self._pcache.evict_lru(self._page_ref)
+            if page is None:
+                return None
+        else:
+            return None
+        self._page_ref[page] = 1
+        return page
+
+    def _release_page(self, page):
+        page = int(page)
+        if page <= 0:
+            return
+        ref = int(self._page_ref[page]) - 1
+        assert ref >= 0, f"draft page {page} refcount went negative"
+        self._page_ref[page] = ref
+        if ref == 0 and not (self._pcache is not None
+                             and self._pcache.contains_page(page)):
+            self._free_pages.append(page)
+
     def _ensure_pages(self, slot, new_len) -> bool:
         need = min(self._pages_needed(new_len), self.max_pages_per_seq)
         have = int(np.count_nonzero(self.tables[slot]))
         taken: List[int] = []
         for i in range(have, need):
-            if not self._free_pages:
-                for j, pg in zip(range(have, have + len(taken)), taken):
+            page = self._alloc_page()
+            if page is None:
+                for j in range(have, have + len(taken)):
                     self.tables[slot, j] = 0
-                self._free_pages.extend(reversed(taken))
+                for pg in reversed(taken):
+                    self._release_page(pg)
                 return False
-            taken.append(self._free_pages.pop())
-            self.tables[slot, i] = taken[-1]
+            taken.append(page)
+            self.tables[slot, i] = page
         return True
 
     def _trim_pages(self, slot, keep_len):
         need = self._pages_needed(keep_len)
         have = int(np.count_nonzero(self.tables[slot]))
         for i in range(have - 1, need - 1, -1):
-            self._free_pages.append(int(self.tables[slot, i]))
+            self._release_page(int(self.tables[slot, i]))
             self.tables[slot, i] = 0
 
     def release(self, slot):
-        """Forget a slot (request finished / preempted / slot reused)."""
-        self._free_pages.extend(int(p) for p in self.tables[slot] if p)
+        """Forget a slot (request finished / preempted / slot reused).
+        Refcount-aware: cached draft pages stay resident at refcount 0."""
+        for p in self.tables[slot]:
+            if p:
+                self._release_page(int(p))
         self.tables[slot, :] = 0
         self.lengths[slot] = 0
         self._slot_rid[slot] = -1
@@ -192,6 +234,11 @@ class DraftModelDrafter:
         self.tables[:] = 0
         self.lengths[:] = 0
         self._free_pages = list(range(self.num_pages - 1, 0, -1))
+        # cached content died with the buffers: flush (stale-pointer
+        # safety, same contract as Engine._reset_pool)
+        self._page_ref[:] = 0
+        if self._pcache is not None:
+            self._pcache.clear()
         self._slot_rid[:] = -1
 
     # ------------------------------------------------------ jit bodies
@@ -295,6 +342,18 @@ class DraftModelDrafter:
             if int(self._slot_rid[slot]) != req.rid:
                 self.release(slot)
                 self._slot_rid[slot] = req.rid
+                if self._pcache is not None and expected > 0:
+                    # re-prefill (admission / preemption / slot reuse)
+                    # hits the draft-side prefix cache too (ISSUE 8):
+                    # splice the cached block-aligned prefix so the
+                    # catch-up forward only computes the uncached tail.
+                    # matched is block-aligned and <= expected, so the
+                    # catch-up/propose writes land past every shared page
+                    pages, matched = self._pcache.lookup(hist[:expected])
+                    for i, p in enumerate(pages):
+                        self.tables[slot, i] = p
+                        self._page_ref[p] += 1
+                    self.lengths[slot] = matched
             cached = int(self.lengths[slot])
             if cached > expected:
                 # roll back past-propose rows the verifier rejected
@@ -346,6 +405,19 @@ class DraftModelDrafter:
             self._set_pages(pages)
             for i, (s, _) in enumerate(rows):
                 self.lengths[s] = int(lengths_c[i] + delta_c[i])
+        if self._pcache is not None:
+            # publish every synced slot's full draft-KV blocks (content-
+            # addressed, so a future re-prefill of the same history — or
+            # another request sharing the template — splices them)
+            for s, req in zip(slots, reqs):
+                if s in degraded:
+                    continue
+                hist = _history(req)
+                full = int(self.lengths[s]) // self.page_size
+                if full:
+                    self._pcache.register(
+                        hist[:full * self.page_size],
+                        [int(self.tables[s, i]) for i in range(full)])
         # ---- propose scan: k greedy steps for the whole batch ----------
         for i, s in enumerate(slots):
             if s not in degraded and not self._ensure_pages(

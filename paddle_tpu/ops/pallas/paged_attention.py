@@ -743,12 +743,31 @@ def paged_state_verify(state, q, k, v, scale=None):
     the next append overwrites — the same data-only-exists-up-to-lengths
     invariant the trash page relies on) and returns the headroom pages via
     ``Engine._trim_pages``. Idle slots (length 0) write to the trash page
-    and read garbage the engine discards, exactly like the decode step."""
+    and read garbage the engine discards, exactly like the decode step.
+
+    With ``state.prefill_valid`` set this is a PARTIAL PREFILL (prefix
+    cache, ISSUE 8): row b holds ``lengths[b]`` cached tokens (spliced
+    pages a prior request computed) and appends its ``prefill_valid[b]``
+    uncached suffix tokens — every suffix position attends over the
+    cached prefix plus the causal part of the fresh block, exactly the
+    multi-query semantics the verify path already implements. Columns
+    past a row's valid width write to the trash page and advance nothing;
+    a row with ``lengths == 0`` (a cache miss sharing the wave) reduces
+    to a from-scratch prefill, and a row with ``prefill_valid == 0`` (a
+    pad row) is idle."""
     b, m = q.shape[:2]
     base = state.lengths
-    active = base > 0
+    if state.prefill_valid is not None:
+        widths = jnp.asarray(state.prefill_valid, jnp.int32)
+        active = widths > 0
+        valid = (jnp.arange(m, dtype=jnp.int32)[None, :]
+                 < widths[:, None])  # [B, m] per-row suffix mask
+        adv = widths
+    else:
+        active = base > 0
+        valid = jnp.broadcast_to(active[:, None], (b, m))
+        adv = m * active.astype(state.lengths.dtype)
     pos = state.positions(m)  # [B, m], clamped at capacity - 1
-    valid = jnp.broadcast_to(active[:, None], (b, m))
     logical = jnp.clip(pos // state.page_size, 0,
                        state.block_tables.shape[1] - 1)
     phys = jnp.where(valid,
@@ -761,7 +780,7 @@ def paged_state_verify(state, q, k, v, scale=None):
         k_pages=state.k_pages.at[phys, slotpos].set(kq),
         v_pages=state.v_pages.at[phys, slotpos].set(vq),
         lengths=jnp.minimum(
-            base + m * active.astype(state.lengths.dtype), cap),
+            base + adv.astype(state.lengths.dtype), cap),
     )
     if state.quantized:
         new["scale_pages"] = state.scale_pages.at[phys, slotpos].set(sc)

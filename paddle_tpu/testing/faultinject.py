@@ -24,6 +24,12 @@ Injection points (the engine's hook sites; see README "Failure semantics"):
   fallback / rejection machinery.
 * ``slow-step``        — sleeps ``delay_ms`` at the top of ``step()``,
   driving deadline/TTL expiry deterministically.
+* ``prefix-cache-corruption`` — flips one cached page's device bytes at a
+  prefix-cache hit (when the page is idle; an in-use page is never
+  corrupted by the harness) and signals doubt: the cache invalidates the
+  page and every descendant block, the admission recomputes from scratch,
+  and the corruption is provably isolated to a cache MISS — never a wrong
+  token (ISSUE 8).
 
 Training points (ISSUE 7 — consulted by ``distributed/checkpoint.py``,
 ``distributed/ckpt_manager.py`` and the ``hapi.Model.fit`` train loop):
@@ -82,6 +88,7 @@ POINTS = (
     "nan-logits",
     "drafter-corruption",
     "slow-step",
+    "prefix-cache-corruption",
     # training-resilience points (ISSUE 7)
     "ckpt-io-error",
     "train-step-exception",

@@ -1,0 +1,498 @@
+"""Prefix-cache suite (ISSUE 8): refcounted copy-on-write page reuse in
+the paged serving engine.
+
+The load-bearing invariant, asserted throughout: with the cache ENABLED,
+every request's output tokens are identical to a cache-off run — greedy
+and temperature>0, spec on and off, under preemption pressure, engine
+fault recovery, and injected cache corruption. On top of that, the
+allocator invariants the tentpole rewires: refcounts never go negative,
+eviction never touches a referenced page, COW divergence isolates writes,
+preempting a cache-sharing slot leaves its peers' pages intact, and slot
+release stays idempotent under refcounts. Runs on CPU as part of tier-1
+(``make chaos``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.inference.prefix_cache import PrefixCache
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import metric_total, render_prometheus
+
+PAGE = 8
+PLENS = (20, 24, 18, 9, 22)
+BUDGET = 10
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                    max_position=128, vocab_size=97)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def make_engine(gpt, cache=True, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("dtype", jnp.float32)
+    return Engine(gpt, prefix_cache=cache, **kw)
+
+
+def prompts():
+    r = np.random.default_rng(0)
+    return [r.integers(0, 97, (n,)) for n in PLENS]
+
+
+def serve_twice(eng, temp=0.0):
+    """Two identical waves through one engine — the second admits into a
+    warm cache. Returns both waves' token lists."""
+    outs = []
+    for _ in range(2):
+        reqs = [eng.add_request(p, BUDGET, temperature=temp, seed=11 + i)
+                for i, p in enumerate(prompts())]
+        eng.run()
+        assert all(r.done and not r.failed for r in reqs), \
+            [(r.failure_reason, r.failure) for r in reqs]
+        outs.append([list(r.tokens) for r in reqs])
+    return outs
+
+
+@pytest.fixture(scope="module")
+def clean(gpt):
+    """Cache-OFF baseline token streams (greedy), by request index."""
+    eng = make_engine(gpt, cache=False)
+    out = serve_twice(eng)
+    assert out[0] == out[1]  # cache-off determinism
+    return out[0]
+
+
+def assert_conserved(eng):
+    """Every physical page is in exactly one ownership state, refcounts
+    match the table references, and nothing leaked."""
+    free = eng._free_pages
+    assert len(set(free)) == len(free), "duplicate free pages"
+    cached = set(eng._pcache._by_page) if eng._pcache is not None else set()
+    assert set(free).isdisjoint(cached), "free page still cached"
+    refs = np.zeros_like(eng._page_ref)
+    for row in eng.tables:
+        for p in row:
+            if p:
+                refs[int(p)] += 1
+    assert np.array_equal(refs, eng._page_ref), "refcounts drifted"
+    active = {int(p) for row in eng.tables for p in row if p}
+    assert set(free).isdisjoint(active)
+    assert set(free) | cached | active == set(range(1, eng.num_pages)), \
+        "pages leaked"
+    assert (eng._page_ref >= 0).all()
+
+
+# ---------------------------------------------------------------- unit
+class TestPrefixCacheUnit:
+    def test_chain_lookup_roundtrip(self):
+        pc = PrefixCache(4)
+        toks = np.arange(12, dtype=np.int32)
+        assert pc.register(toks, [5, 6, 7]) == 3
+        pages, matched = pc.lookup(toks)
+        assert pages == [5, 6, 7] and matched == 12
+        # block-aligned: a 10-token prefix matches 2 blocks
+        pages, matched = pc.lookup(toks[:10])
+        assert pages == [5, 6] and matched == 8
+        # divergence mid-chain stops the walk
+        div = toks.copy()
+        div[6] = 90
+        pages, matched = pc.lookup(div)
+        assert pages == [5] and matched == 4
+        # a different FIRST block shares nothing even if later blocks
+        # match token-wise (chain hash commits to the whole prefix)
+        shifted = np.concatenate([[77], toks[1:]]).astype(np.int32)
+        assert pc.lookup(shifted) == ([], 0)
+
+    def test_register_dedup_keeps_first(self):
+        pc = PrefixCache(4)
+        toks = np.arange(8, dtype=np.int32)
+        assert pc.register(toks, [3, 4]) == 2
+        assert pc.register(toks, [9, 10]) == 0  # duplicate content
+        assert pc.lookup(toks)[0] == [3, 4]
+        assert pc.n_pages == 2
+
+    def test_verify_on_hit_catches_tampered_entry(self):
+        pc = PrefixCache(4)
+        toks = np.arange(8, dtype=np.int32)
+        pc.register(toks, [3, 4])
+        # simulate a hash collision / corrupted index: entry tokens no
+        # longer match what the key claims
+        ent = next(iter(pc._by_key.values()))
+        ent.tokens = ent.tokens + 1
+        pages, matched = pc.lookup(toks)
+        assert matched < 8  # degraded to a (partial) miss, not wrong pages
+
+    def test_lru_evicts_leaf_first_and_oldest(self):
+        pc = PrefixCache(4)
+        a = np.arange(8, dtype=np.int32)
+        b = np.arange(100, 108, dtype=np.int32)
+        pc.register(a, [1, 2])
+        pc.register(b, [3, 4])
+        pc.lookup(a)  # touch chain a
+        ref = np.zeros(16, np.int64)
+        # oldest chain (b) unwinds first, leaf before parent
+        assert pc.evict_lru(ref) == 4
+        assert pc.evict_lru(ref) == 3
+        assert pc.evict_lru(ref) == 2  # then a's leaf
+        assert pc.evict_lru(ref) == 1
+        assert pc.evict_lru(ref) is None
+        assert pc.evictions == 4
+
+    def test_evict_never_touches_referenced_pages(self):
+        pc = PrefixCache(4)
+        pc.register(np.arange(8, dtype=np.int32), [1, 2])
+        ref = np.zeros(16, np.int64)
+        ref[2] = 1  # leaf page is live
+        # leaf pinned -> parent is interior -> nothing evictable
+        assert pc.evict_lru(ref) is None
+        ref[2] = 0
+        assert pc.evict_lru(ref) == 2
+
+    def test_invalidate_drops_descendants(self):
+        pc = PrefixCache(4)
+        toks = np.arange(16, dtype=np.int32)
+        pc.register(toks, [1, 2, 3, 4])
+        dropped = pc.invalidate_page(2)
+        assert sorted(dropped) == [2, 3, 4]  # block 1 and everything under
+        pages, matched = pc.lookup(toks)
+        assert pages == [1] and matched == 4
+
+
+# ----------------------------------------------------- splice + identity
+class TestSpliceIdentity:
+    def test_cache_on_matches_cache_off_greedy(self, gpt, clean):
+        eng = make_engine(gpt)
+        out = serve_twice(eng)
+        assert out[0] == clean  # cold pass (all misses)
+        assert out[1] == clean  # warm pass (splices cached prefixes)
+        assert eng._pcache.hits >= 4
+        assert metric_total("paddle_tpu_prefix_cached_prefill_tokens_total") > 0
+        assert_conserved(eng)
+
+    def test_cache_on_matches_cache_off_sampled(self, gpt):
+        off = serve_twice(make_engine(gpt, cache=False), temp=0.7)
+        eng = make_engine(gpt)
+        on = serve_twice(eng, temp=0.7)
+        assert on == off
+        assert eng._pcache.hits >= 4
+
+    def test_full_prompt_match_cow(self, gpt, rng):
+        """A block-aligned full-prefix hit: the last matched page is
+        copied (COW) so the recomputed final token and subsequent decode
+        writes never touch the shared original."""
+        p = rng.integers(0, 97, (2 * PAGE,))  # exactly 2 blocks
+        off = make_engine(gpt, cache=False)
+        r0 = off.add_request(p, BUDGET)
+        off.run()
+        eng = make_engine(gpt)
+        r1 = eng.add_request(p, BUDGET)
+        eng.run()
+        shared = np.asarray(sorted(eng._pcache._by_page), np.int32)
+        before = [np.asarray(eng.k_pages[i][shared]).copy()
+                  for i in range(len(eng.k_pages))]
+        r2 = eng.add_request(p, BUDGET)
+        eng.run()
+        assert list(r1.tokens) == list(r0.tokens) == list(r2.tokens)
+        # full match: 2 blocks cached, COW trims one recomputed token
+        assert eng._pcache.hits == 1
+        assert metric_total(
+            "paddle_tpu_prefix_cached_prefill_tokens_total") >= 2 * PAGE - 1
+        # isolated writes: the cached originals' bytes are untouched by
+        # the second request's recompute + decode
+        for i in range(len(eng.k_pages)):
+            assert np.array_equal(
+                np.asarray(eng.k_pages[i][shared]), before[i])
+        assert_conserved(eng)
+
+    def test_mixed_hit_miss_wave(self, gpt, clean, rng):
+        """One admission wave mixing a cached prefix with a never-seen
+        prompt: both outputs match the cache-off baseline."""
+        eng = make_engine(gpt)
+        base = serve_twice(eng)  # warm the cache with the PLENS prompts
+        assert base[1] == clean
+        fresh = rng.integers(0, 97, (17,))
+        reqs = [eng.add_request(prompts()[0], BUDGET),
+                eng.add_request(fresh, BUDGET)]
+        off = make_engine(gpt, cache=False)
+        refs = [off.add_request(prompts()[0], BUDGET),
+                off.add_request(fresh, BUDGET)]
+        eng.run()
+        off.run()
+        assert [list(r.tokens) for r in reqs] == \
+            [list(r.tokens) for r in refs]
+
+    def test_llama_hits_through_same_glue(self, rng):
+        """The cache is model-agnostic: LLaMA (RoPE positions through the
+        same PagedCacheState glue) splices and stays identical."""
+        from paddle_tpu.models import LlamaForCausalLM, tiny_llama_config
+
+        paddle.seed(2)
+        lcfg = tiny_llama_config()
+        lm = LlamaForCausalLM(lcfg)
+        lm.eval()
+        p = rng.integers(0, lcfg.vocab_size, (2 * PAGE + 3,))
+
+        def run(cache):
+            eng = Engine(lm, max_slots=2, num_pages=64, page_size=PAGE,
+                         chunk_size=4, dtype=jnp.float32,
+                         prefix_cache=cache)
+            outs = []
+            for _ in range(2):
+                req = eng.add_request(p, 8)
+                eng.run()
+                assert req.done and not req.failed
+                outs.append(list(req.tokens))
+            return outs, eng
+
+        off, _ = run(False)
+        on, eng = run(True)
+        assert on == off
+        assert eng._pcache.hits == 1
+
+
+# ------------------------------------------------- allocator invariants
+class TestAllocatorInvariants:
+    def test_refcount_never_negative(self, gpt):
+        eng = make_engine(gpt)
+        serve_twice(eng)
+        assert_conserved(eng)
+        # a rogue double release trips the assertion instead of silently
+        # corrupting the free list
+        page = eng._alloc_page()
+        eng._release_page(page)
+        with pytest.raises(AssertionError, match="refcount"):
+            eng._release_page(page)
+        eng._free_pages.remove(page)  # undo the probe's free-list entry
+
+    def test_eviction_reclaims_idle_cache_before_preempting(self, gpt):
+        """A pool where a fresh wave can only be served by reclaiming the
+        previous wave's idle cached pages: LRU eviction absorbs ALL the
+        pressure (zero preemptions), outputs match cache-off exactly."""
+        r = np.random.default_rng(3)
+        wave_a = [r.integers(0, 97, (24,)) for _ in range(3)]
+        wave_b = [r.integers(0, 97, (24,)) for _ in range(3)]
+
+        def serve(eng, wave):
+            reqs = [eng.add_request(p, BUDGET) for p in wave]
+            eng.run()
+            assert all(q.done and not q.failed for q in reqs)
+            return [list(q.tokens) for q in reqs]
+
+        off = make_engine(gpt, cache=False, num_pages=24)
+        base_a = serve(off, wave_a)
+        base_b = serve(off, wave_b)
+        pre0 = metric_total("paddle_serving_preemptions_total")
+        eng = make_engine(gpt, num_pages=24)
+        assert serve(eng, wave_a) == base_a  # leaves 9 blocks resident
+        # wave B shares nothing: its allocations must evict A's pages
+        assert serve(eng, wave_b) == base_b
+        assert metric_total("paddle_tpu_prefix_cache_evictions_total") > 0
+        assert metric_total("paddle_serving_preemptions_total") == pre0
+        assert_conserved(eng)
+
+    def test_preempt_cache_sharing_slot_leaves_peers_intact(self, gpt,
+                                                            rng):
+        """Two active requests sharing spliced pages; preempting one must
+        leave the peer's table pages referenced and its output right."""
+        p = rng.integers(0, 97, (2 * PAGE + 4,))
+        off = make_engine(gpt, cache=False, max_slots=2)
+        a0 = off.add_request(p, 12)
+        b0 = off.add_request(p, 12)
+        off.run()
+        eng = make_engine(gpt, max_slots=2, max_chain=1)
+        seed = eng.add_request(p, 12)
+        eng.run()  # populate the cache
+        a = eng.add_request(p, 12)
+        b = eng.add_request(p, 12)
+        eng.step()  # both admitted, sharing the cached blocks
+        assert a.slot is not None and b.slot is not None
+        shared = set(eng._pcache._by_page)
+        assert any(int(pg) in shared for pg in eng.tables[a.slot])
+        assert any(int(pg) in shared for pg in eng.tables[b.slot])
+        eng._preempt(a.slot)  # force-evict the sharer
+        for pg in eng.tables[b.slot]:
+            if int(pg) in shared:
+                assert eng._page_ref[int(pg)] >= 1
+        eng.run()
+        assert list(a.tokens) == list(a0.tokens) == list(seed.tokens)
+        assert list(b.tokens) == list(b0.tokens)
+        assert_conserved(eng)
+
+    def test_double_free_slot_idempotent_under_refcounts(self, gpt, rng):
+        eng = make_engine(gpt)
+        seed = eng.add_request(rng.integers(0, 97, (2 * PAGE + 1,)), 6)
+        eng.run()
+        req = eng.add_request(seed.prompt, 6)
+        eng._admit()
+        slot = req.slot
+        assert eng._pcache.hits == 1  # spliced shared pages are in play
+        eng._active.pop(slot)
+        eng._free_slot(slot)
+        free = list(eng._free_pages)
+        refs = eng._page_ref.copy()
+        eng._free_slot(slot)  # double free: must be a no-op
+        assert eng._free_pages == free
+        assert np.array_equal(eng._page_ref, refs)
+        assert eng._free_slots.count(slot) == 1
+        assert_conserved(eng)
+
+    def test_trim_releases_shared_pages_safely(self, gpt, rng):
+        eng = make_engine(gpt)
+        seed = eng.add_request(rng.integers(0, 97, (2 * PAGE,)), 6)
+        eng.run()
+        req = eng.add_request(seed.prompt, 6)
+        eng._admit()
+        slot = req.slot
+        cached_before = eng._pcache.n_pages
+        eng._trim_pages(slot, 0)  # release every table entry
+        eng.tables[slot, :] = 0
+        eng.lengths[slot] = 0
+        # shared pages went back to cache-resident (not the free list)
+        assert eng._pcache.n_pages == cached_before
+        eng._active.pop(slot)
+        eng._free_slots.append(slot)
+        assert_conserved(eng)
+
+    def test_spec_greedy_identity_cache_on(self, gpt, clean):
+        """PR 5's invariant through the cache: ngram spec decode with the
+        prefix cache on produces cache-off vanilla tokens exactly."""
+        eng = make_engine(gpt, spec="ngram", spec_k=4)
+        out = serve_twice(eng)
+        assert out[0] == clean and out[1] == clean
+        assert eng._pcache.hits >= 4
+
+    def test_spec_draft_identity_and_drafter_cache(self, gpt, clean):
+        paddle.seed(5)
+        dcfg = GPTConfig(hidden_size=32, num_layers=1, num_heads=2,
+                         max_position=128, vocab_size=97)
+        dm = GPTForCausalLM(dcfg)
+        dm.eval()
+        eng = make_engine(gpt, spec="draft", draft_model=dm, spec_k=4)
+        out = serve_twice(eng)
+        assert out[0] == clean and out[1] == clean
+        d = eng._spec.drafter
+        assert d._pcache is not None and d._pcache.hits >= 1
+        assert (d._page_ref >= 0).all()
+        assert len(set(d._free_pages)) == len(d._free_pages)
+
+
+# --------------------------------------------------- faults + recovery
+class TestFaultInteraction:
+    def test_corruption_isolates_to_miss(self, gpt, clean):
+        """The prefix-cache-corruption point: a doubted (and actually
+        byte-flipped) cached page is invalidated, the admission
+        recomputes, and every output matches the fault-free cache-off
+        run — corruption costs misses, never tokens."""
+        eng = make_engine(gpt, fault_plan="prefix-cache-corruption:every=1")
+        out = serve_twice(eng)
+        assert out[0] == clean and out[1] == clean
+        assert eng._fi.fired("prefix-cache-corruption") >= 1
+        assert eng._pcache.hits == 0  # every would-be hit was doubted
+        assert_conserved(eng)
+
+    def test_reset_pool_flushes_cache(self, gpt):
+        eng = make_engine(gpt)
+        serve_twice(eng)
+        assert eng._pcache.n_pages > 0
+        eng._reset_pool()
+        assert eng._pcache.n_pages == 0
+        assert len(eng._free_pages) == eng.num_pages - 1
+        assert int(eng._page_ref.sum()) == 0
+        # post-flush service is a clean cold start
+        out = serve_twice(eng)
+        assert out[0] == out[1]
+
+    def test_step_exception_with_cache_enabled(self, gpt, clean):
+        """ISSUE 8 satellite: a step-exception fault on a WARM cache —
+        the faulted request is isolated, everyone else (including cache
+        hitters) matches the fault-free cache-off run."""
+        eng = make_engine(gpt, fault_plan="step-exception:rid=6,at=1")
+        reqs1 = [eng.add_request(p, BUDGET) for p in prompts()]
+        eng.run()  # warm pass populates the cache, rids 0..4
+        reqs2 = [eng.add_request(p, BUDGET) for p in prompts()]
+        eng.run()  # rid 6 faults at its (cache-hit) admission harvest
+        assert [list(r.tokens) for r in reqs1] == clean
+        assert reqs2[1].state == "FAILED"
+        assert reqs2[1].failure_reason == "step_fault"
+        for i, r in enumerate(reqs2):
+            if i == 1:
+                continue
+            assert r.done and not r.failed
+            assert list(r.tokens) == clean[i]
+        assert_conserved(eng)
+
+    def test_dispatch_death_recovery_flushes_and_matches(self, gpt, clean,
+                                                         monkeypatch):
+        """Engine-scoped fault on a warm cache: _recover_step_fault's
+        pool reset must flush the cache (the rebuilt buffers hold zeros,
+        not the hashed content), and post-recovery outputs must match the
+        fault-free cache-off run exactly."""
+        rec0 = metric_total("paddle_tpu_engine_recoveries_total")
+        orig = Engine._get_decode
+        state = {"armed": False, "fired": False}
+
+        def dying_get_decode(self, nb, k, sampling):
+            fn = orig(self, nb, k, sampling)
+
+            def wrapper(*a, **kw):
+                if state["armed"]:
+                    state["armed"] = False
+                    state["fired"] = True
+                    raise RuntimeError("injected dispatch death")
+                return fn(*a, **kw)
+
+            return wrapper
+
+        monkeypatch.setattr(Engine, "_get_decode", dying_get_decode)
+        eng = make_engine(gpt)
+        warm = [eng.add_request(p, BUDGET) for p in prompts()]
+        eng.run()  # cache populated, nothing armed yet
+        assert [list(r.tokens) for r in warm] == clean
+        pages_cached = eng._pcache.n_pages
+        assert pages_cached > 0
+        state["armed"] = True  # next decode dispatch dies mid-step
+        reqs = [eng.add_request(p, BUDGET) for p in prompts()]
+        eng.run()  # must not raise
+        assert state["fired"]
+        assert metric_total(
+            "paddle_tpu_engine_recoveries_total") == rec0 + 1
+        assert [list(r.tokens) for r in reqs] == clean
+        assert all(not r.failed for r in reqs)
+        assert_conserved(eng)
+
+
+# ------------------------------------------------------------ telemetry
+class TestScrapeVisibility:
+    def test_prefix_metrics_visible(self, gpt):
+        eng = make_engine(gpt)
+        serve_twice(eng)
+        eng.step()  # one more step records the pool-share gauge
+        text = render_prometheus()
+        for name in ("paddle_tpu_prefix_cache_hits_total",
+                     "paddle_tpu_prefix_cache_misses_total",
+                     "paddle_tpu_prefix_cache_evictions_total",
+                     "paddle_tpu_prefix_cached_prefill_tokens_total",
+                     "paddle_tpu_prefix_computed_prefill_tokens_total",
+                     "paddle_tpu_prefix_cache_pages"):
+            assert name in text, name
+
+    def test_ttft_histogram_still_records(self, gpt):
+        """Satellite guard: TTFT observations keep flowing when hits make
+        the first token arrive via the suffix program."""
+        from paddle_tpu.observability import histogram_summary
+
+        t0 = histogram_summary("paddle_serving_ttft_seconds").get("count", 0)
+        eng = make_engine(gpt)
+        serve_twice(eng)
+        assert histogram_summary("paddle_serving_ttft_seconds")["count"] \
+            >= t0 + 2 * len(PLENS)

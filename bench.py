@@ -275,6 +275,63 @@ def bench_decode(cfg, on_tpu):
     return out
 
 
+def bench_verify_slab(cfg, on_tpu):
+    """ms per multi-query verify/suffix slab attention dispatch at the
+    serving geometry (ISSUE 9): the attention program spec verify,
+    prefix-cache suffix prefill and chunked prefill all ride — the fused
+    Pallas slab kernel on TPU, its jnp window-gather twin on CPU. One
+    layer's call at spec shape (m = k+1 = 5), scan-fenced like the
+    microbenches; ``tools/mb_verify.py`` holds the full m×batch×pages
+    sweep."""
+    try:
+        from paddle_tpu.ops.pallas.paged_attention import (
+            PagedCacheState, paged_multi_query_attention)
+
+        n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        d = cfg.hidden_size // cfg.num_heads
+        batch, m = (8, 5) if on_tpu else (2, 5)
+        page_size = 16
+        max_pages = cfg.max_position // page_size
+        live = max_pages // 2
+        rng = np.random.default_rng(2)
+        n_pages = 1 + batch * max_pages
+        kp = jnp.asarray(
+            rng.standard_normal((n_pages, page_size, n_kv * d)) * 0.3,
+            jnp.bfloat16)
+        vp = jnp.asarray(
+            rng.standard_normal((n_pages, page_size, n_kv * d)) * 0.3,
+            jnp.bfloat16)
+        bt = jnp.asarray(np.arange(1, 1 + batch * max_pages,
+                                   dtype=np.int32).reshape(batch, -1))
+        base = jnp.full((batch,), live * page_size, jnp.int32)
+        st = PagedCacheState(kp, vp, None, bt,
+                             base + m, page_size)
+        q = jnp.asarray(rng.standard_normal((batch, m, cfg.num_heads, d))
+                        * 0.3, jnp.bfloat16)
+
+        @jax.jit
+        def loop(q):
+            def body(carry, _):
+                q, acc = carry
+                s = jnp.sum(paged_multi_query_attention(
+                    q, st, base).astype(jnp.float32))
+                return (q * (1.0 + 0.0 * s).astype(q.dtype), acc + s), None
+
+            (_, acc), _ = jax.lax.scan(body, (q, jnp.float32(0)), None,
+                                       length=30 if on_tpu else 2)
+            return acc
+
+        float(jax.device_get(loop(q)))  # compile + warm
+        t0 = time.perf_counter()
+        float(jax.device_get(loop(q)))
+        dt = (time.perf_counter() - t0) / (30 if on_tpu else 2)
+        return {"decode_verify_slab_ms": round(dt * 1e3, 4),
+                "decode_verify_slab_m": m,
+                "decode_verify_slab_batch": batch}
+    except Exception as e:
+        return {"verify_slab_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_paged_decode(cfg, on_tpu):
     """Continuous-batching engine over the paged KV cache (serving
     flagship): mixed workload driven through inference.Engine; reports
@@ -480,6 +537,7 @@ def main():
         decode_cfg = tiny
 
     decode = bench_decode(decode_cfg, on_tpu)
+    vslab = bench_verify_slab(decode_cfg, on_tpu)
     paged = bench_paged_decode(decode_cfg, on_tpu)
     spec = bench_spec(decode_cfg, on_tpu)
     fault = bench_fault(decode_cfg, on_tpu)
@@ -542,6 +600,13 @@ def main():
             metric_total("paddle_tpu_prefix_computed_prefill_tokens_total")),
         "prefix_evictions": int(
             metric_total("paddle_tpu_prefix_cache_evictions_total")),
+        # decode hot-path kernel surface (ISSUE 9): prompt chunks
+        # streamed through mixed steps, and fused-slab-path dispatches
+        # across the three consumers (verify / suffix / chunked)
+        "prefill_chunks": int(
+            metric_total("paddle_tpu_prefill_chunks_total")),
+        "slab_verify_dispatches": int(
+            metric_total("paddle_tpu_slab_verify_dispatch_total")),
         # training-resilience surface (ISSUE 7): checkpoint commits and
         # the in-loop guard counters as the registry saw them
         "train_checkpoints": int(
@@ -578,6 +643,7 @@ def main():
             "s4096_batch": r_4k["batch"]} if r_4k else {}),
         "device": getattr(jax.devices()[0], "device_kind", "unknown"),
         **decode,
+        **vslab,
         **paged,
         **spec,
         **fault,

@@ -53,6 +53,15 @@ def main():
                          "block-aligned prompt prefixes into their page "
                          "table and prefill only the uncached suffix; "
                          "output tokens are identical either way")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="chunked prefill (ISSUE 9): stream prompts "
+                         "into the cache this many tokens per mixed "
+                         "chunk+decode step (the fused slab-attention "
+                         "program) instead of one bucketed prefill "
+                         "dispatch — long prompts stop stalling the "
+                         "decode batch and the cold-start compile "
+                         "surface collapses to one program; output "
+                         "tokens are identical either way")
     ap.add_argument("--deadline-ms", type=float, default=None,
                     help="per-request TTL (ISSUE 6): a request that "
                          "hasn't finished this many ms after submission "
@@ -134,7 +143,8 @@ def main():
                              if args.deadline_ms is not None else None),
                  max_queue=args.max_queue,
                  fault_plan=args.fault_inject,
-                 prefix_cache=args.prefix_cache == "on")
+                 prefix_cache=args.prefix_cache == "on",
+                 prefill_chunk=args.prefill_chunk)
     rng = np.random.default_rng(0)
 
     # mixed-length requests, more requests than slots: admission interleaves

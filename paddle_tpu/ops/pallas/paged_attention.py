@@ -43,7 +43,8 @@ NEG_INF = -1.0e30
 _Q_ROWS = 8  # pad the single q row to a full sublane tile
 
 __all__ = ["paged_decode_attention", "paged_decode_attention_ref",
-           "PagedKVCache", "quantize_rows_int8"]
+           "PagedKVCache", "quantize_rows_int8",
+           "paged_verify_slab_attention", "paged_multi_query_attention"]
 
 
 def _interpret() -> bool:
@@ -524,6 +525,186 @@ def _paged_slab_ref(q, k_pages, v_pages, block_tables, lengths, scale,
     return decode_attention_ref(q, k_c, v_c, lengths, scale).astype(q.dtype)
 
 
+# ------------------------------------------ verify/suffix slab kernel (v3)
+# The multi-query twin of the slab decode kernel (ISSUE 9 tentpole a): one
+# program per batch element DMA-gathers that row's live pages into VMEM
+# (cached prefix PLUS the freshly written slab) and scores a slab of m
+# query positions against the window — query j of row b attends tokens
+# < base_len[b] + j + 1, exactly `_paged_multi_query_ref`'s causal-window
+# semantics. ONE kernel replaces the jnp window-gather for spec-decode
+# verify (m = k+1), prefix-cache suffix prefill (per-row widths, base 0
+# on miss rows) and chunked prefill (m = chunk, decode rows at width 1):
+# the gather of pages moves the same bytes the decode kernel moves per
+# step, amortized over all m positions, with zero XLA gathers.
+#
+# Softmax is computed in the exact elementwise order of jax.nn.softmax
+# (exp(s - max) normalized BEFORE the PV dot), so interpret-mode output
+# is bitwise identical to the jnp reference — the parity tests assert
+# equality, not closeness.
+
+
+def _paged_verify_slab_kernel(base_ref, bt_ref, q_ref, kp_ref, vp_ref,
+                              sc_ref, o_ref, kwin, vwin, scwin, kv_sem,
+                              sc_sem, *, scale, num_heads, head_dim, m,
+                              page_size, max_pages, quantized):
+    b = pl.program_id(0)
+    base = base_ref[b]
+    seq = max_pages * page_size
+    # the window must cover the cached prefix plus the freshly written
+    # slab; clamp like the ref so an overshooting row (base + m past the
+    # table capacity) never drives OOB block-table reads or DMA writes
+    limit_max = jnp.minimum(base + m, seq)
+    npages = jnp.minimum((limit_max + page_size - 1) // page_size,
+                         max_pages)
+
+    def issue(j, _):
+        pg = bt_ref[b, j]
+        pltpu.make_async_copy(
+            kp_ref.at[pl.ds(pg, 1)], kwin.at[pl.ds(j, 1)], kv_sem).start()
+        pltpu.make_async_copy(
+            vp_ref.at[pl.ds(pg, 1)], vwin.at[pl.ds(j, 1)], kv_sem).start()
+        if quantized:
+            pltpu.make_async_copy(
+                sc_ref.at[pl.ds(pg, 1)], scwin.at[pl.ds(j, 1)],
+                sc_sem).start()
+        return _
+
+    jax.lax.fori_loop(0, npages, issue, 0)
+
+    # zero the dead tail while the live DMAs fly (stale NaN patterns
+    # would poison the PV dot via 0*NaN)
+    def ztail(j, _):
+        # tpulint: disable=TPL402 -- kwin/vwin/scwin are Pallas VMEM
+        # scratch Refs: in-place Ref stores ARE the kernel-side memory
+        # model, the closure is over memory handles, not traced values
+        kwin[pl.ds(j, 1)] = jnp.zeros((1, page_size, kwin.shape[-1]),
+                                      kwin.dtype)
+        # tpulint: disable=TPL402 -- same scratch-Ref store as above
+        vwin[pl.ds(j, 1)] = jnp.zeros((1, page_size, vwin.shape[-1]),
+                                      vwin.dtype)
+        if quantized:
+            # tpulint: disable=TPL402 -- same scratch-Ref store as above
+            scwin[pl.ds(j, 1)] = jnp.zeros((1, page_size, 128), scwin.dtype)
+        return _
+
+    jax.lax.fori_loop(npages, max_pages, ztail, 0)
+
+    # DMA semaphores count bytes: drain with same-sized descriptors
+    def drain_kv(i, _):
+        pltpu.make_async_copy(
+            kp_ref.at[pl.ds(0, 1)], kwin.at[pl.ds(0, 1)], kv_sem).wait()
+        return _
+
+    jax.lax.fori_loop(0, 2 * npages, drain_kv, 0)
+    if quantized:
+        def drain_sc(i, _):
+            pltpu.make_async_copy(
+                sc_ref.at[pl.ds(0, 1)], scwin.at[pl.ds(0, 1)],
+                sc_sem).wait()
+            return _
+
+        jax.lax.fori_loop(0, npages, drain_sc, 0)
+
+    mp = q_ref.shape[1]  # m rounded up to a sublane tile
+    col = jax.lax.broadcasted_iota(jnp.int32, (mp, seq), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (mp, seq), 0)
+    # causal per-position limits, clamped at the table capacity — the
+    # ref's `limit` expression verbatim
+    mask = col < jnp.minimum(base + row + 1, seq)
+    khd = kwin.shape[-1]
+    h_kv = khd // head_dim
+    group = num_heads // h_kv
+    if quantized:
+        scw = scwin[...].reshape(seq, 128)
+    for h in range(num_heads):
+        kh_ix = h // group
+        lo_q = h * head_dim
+        lo_kv = kh_ix * head_dim
+        qh = q_ref[0, :, lo_q:lo_q + head_dim].astype(jnp.float32)  # [mp,D]
+        kh = kwin[:, :, lo_kv:lo_kv + head_dim].reshape(
+            seq, head_dim).astype(jnp.float32)
+        if quantized:
+            kh = kh * scw[:, kh_ix:kh_ix + 1]
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [mp, seq]
+        s = jnp.where(mask, s, NEG_INF)
+        mx = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - mx)
+        # normalize BEFORE the dot — jax.nn.softmax's order, so the
+        # interpret-mode kernel is bitwise the jnp reference; fully
+        # masked rows degrade to the same uniform distribution
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        vh = vwin[:, :, lo_kv:lo_kv + head_dim].reshape(
+            seq, head_dim).astype(jnp.float32)
+        if quantized:
+            vh = vh * scw[:, h_kv + kh_ix:h_kv + kh_ix + 1]
+        out = jax.lax.dot_general(
+            p, vh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [mp, D]
+        o_ref[0, :, lo_q:lo_q + head_dim] = out
+
+
+def paged_verify_slab_attention(q, k_pages, v_pages, block_tables,
+                                base_len, scale=None, scale_pages=None,
+                                interpret=False):
+    """Fused multi-query verify/suffix slab attention (ISSUE 9).
+
+    q [B, m, H, D] against slab pages [P, page_size, Hkv*D]; query j of
+    row b attends the window tokens ``< base_len[b] + j + 1`` (cached
+    context + causal prefix of the freshly written slab). Returns
+    [B, m, H, D] f32 — bitwise ``_paged_multi_query_ref`` in interpret
+    mode. ``scale_pages`` [P, ps, 128] bf16 activates the int8 path (k
+    scales at lanes [0, Hkv), v at [Hkv, 2Hkv), the decode-slab layout).
+
+    VMEM: the window scratch matches the decode slab kernel; on top of
+    it the per-head score slab is [m_pad, max_pages*page_size] f32, so m
+    is engine-bounded (spec k+1, prefill_chunk, or the suffix bucket
+    ≤ max_position)."""
+    b, m, h, d = q.shape
+    p_total, page_size, khd = k_pages.shape
+    max_pages = block_tables.shape[1]
+    quantized = scale_pages is not None
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    mp = -(-m // _Q_ROWS) * _Q_ROWS
+    qr = q.reshape(b, m, h * d)
+    if mp != m:
+        qr = jnp.pad(qr, ((0, 0), (0, mp - m), (0, 0)))
+    if scale_pages is None:
+        scale_pages = jnp.zeros((1, page_size, 128), jnp.bfloat16)
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_verify_slab_kernel, scale=scale, num_heads=h,
+            head_dim=d, m=m, page_size=page_size, max_pages=max_pages,
+            quantized=quantized),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(b,),
+            in_specs=[
+                pl.BlockSpec((1, mp, h * d), lambda i, bl, bt: (i, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, mp, h * d),
+                                   lambda i, bl, bt: (i, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((max_pages, page_size, khd), k_pages.dtype),
+                pltpu.VMEM((max_pages, page_size, khd), k_pages.dtype),
+                pltpu.VMEM((max_pages, page_size, 128), jnp.bfloat16),
+                pltpu.SemaphoreType.DMA,
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, mp, h * d), jnp.float32),
+        interpret=interpret,
+    )(jnp.asarray(base_len, jnp.int32),
+      jnp.asarray(block_tables, jnp.int32), qr, k_pages, v_pages,
+      scale_pages)
+    return out[:, :m].reshape(b, m, h, d)
+
+
 # ------------------------------------------------- functional (jit) state
 
 
@@ -687,10 +868,9 @@ def _paged_multi_query_ref(q, state, base_len, scale=None):
 
     jnp window-gather implementation (the exact twin family of
     ``_paged_slab_ref``): materializes each slot's padded window once and
-    masks per position. Runs through XLA on every backend — for small m
-    (spec-decode verify blocks, m = k+1 ≤ chunk_size) the gather is the
-    same bytes the slab decode kernel moves per step, amortized over m
-    positions; a fused Pallas slab-verify kernel is the on-chip follow-up.
+    masks per position. The CPU path and the exactness oracle for the
+    fused ``paged_verify_slab_attention`` kernel — production TPU traffic
+    dispatches the kernel via ``paged_multi_query_attention``.
     """
     b, m, h, d = q.shape
     p_total, page_size, khd = state.k_pages.shape
@@ -729,6 +909,22 @@ def _paged_multi_query_ref(q, state, base_len, scale=None):
     s = jnp.where(mask[:, :, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bmhs,bshd->bmhd", p, v_c)
+
+
+def paged_multi_query_attention(q, state, base_len, scale=None):
+    """Multi-position paged attention dispatch — the ONE entry the spec
+    verifier, prefix-cache suffix prefill and chunked prefill all ride:
+    the fused Pallas slab kernel on TPU at tile-aligned shapes (one
+    ``pallas_call``, zero gathers), the jnp window-gather twin elsewhere
+    (CPU tier-1, or sub-128-lane test configs that don't lower through
+    Mosaic)."""
+    b, m, h, d = q.shape
+    khd = state.k_pages.shape[-1]
+    if _interpret() or khd % 128 or (h * d) % 128:
+        return _paged_multi_query_ref(q, state, base_len, scale=scale)
+    return paged_verify_slab_attention(
+        q, state.k_pages, state.v_pages, state.block_tables, base_len,
+        scale=scale, scale_pages=state.scale_pages)
 
 
 def paged_state_verify(state, q, k, v, scale=None):
@@ -785,7 +981,7 @@ def paged_state_verify(state, q, k, v, scale=None):
     if state.quantized:
         new["scale_pages"] = state.scale_pages.at[phys, slotpos].set(sc)
     state = state.replace(**new)
-    out = _paged_multi_query_ref(q, state, base, scale=scale)
+    out = paged_multi_query_attention(q, state, base, scale=scale)
     return out.astype(q.dtype), state
 
 

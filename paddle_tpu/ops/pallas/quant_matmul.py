@@ -1,30 +1,35 @@
 """Fused weight-only quant matmul Pallas kernels for the decode path.
 
 TPU-native rewrite of the ``fused_multi_transformer_int8_op.cu``-class
-weight-only GEMMs (SURVEY A3.x). The plain-XLA path in ``nn/quant.py``
-leans on convert-fusion for int8 and runs packed int4 as TWO dots over
-unpacked nibble halves — BENCH_r05 shows that makes int4 decode *slower*
-than int8 (0.71 vs 0.533 ms/token) despite moving half the HBM bytes.
-Here the dequant happens inside the kernel in VMEM:
+weight-only GEMMs (SURVEY A3.x). Small-batch decode is weight-bandwidth
+bound, so the dequant happens inside the kernel in VMEM and every weight
+byte streams from HBM exactly once:
 
 * int8  — weight block [bk, bn] loads once as int8, casts to the
   activation dtype on the VPU, one MXU dot per (n, k) grid step.
-* int4  — the PACKED byte block [bk//2, bn] loads once; low/high nibbles
-  sign-extend in VMEM (int32 shift pair) and contract against the
-  even/odd activation columns. One pass over the weight bytes, two MXU
-  dots per block, ONE kernel for the whole GEMM.
+* int4  — the PACKED byte block [bk//2, bn] loads once; both nibbles
+  sign-extend in VMEM (int32 shift pair) into ONE dequantized [bk, bn]
+  slab — low-nibble rows stacked over high-nibble rows, paired with the
+  activation's pre-split even/odd K columns so no in-kernel sublane
+  interleave is needed — and a SINGLE full-depth MXU dot contracts the
+  slab (ISSUE 9 tentpole c: the previous two half-depth dots per block
+  doubled the accumulator traffic and left int4 decode SLOWER than int8
+  in BENCH_r05, 0.71 vs 0.533 ms/token, despite half the weight bytes).
 
 f32 accumulation lives in VMEM scratch across the k grid dimension; the
 per-output-channel scale (and optional bias) apply in the epilogue at the
-last k step. Decode rows are padded to a sublane tile; K/N pad up to the
-selected block shape, so non-multiple shapes are handled (the pad is a
-no-op for real model dims, which are multiples of 128).
-
-Block shapes are picked per (rows, in, out, dtype) and memoized through
-``framework.compile_cache.memoize_kernel_choice`` so a warm server never
-retunes mid-flight. On non-TPU backends the kernel runs in Pallas
-interpret mode (exact, slow) — CI covers it; dispatch policy lives in
-``nn/quant.py``.
+last k step. Decode rows pad to a sublane tile. Block shapes are
+DIVISOR-AWARE (``select_block_shapes``): a block that does not divide the
+problem forces ``jnp.pad`` to materialize a padded copy of the whole
+weight OUTSIDE the kernel — an extra full read+write of the weight
+stream per GEMM, which is exactly the traffic the kernel exists to
+avoid (768-dim layers padding to 1024 on both axes was the other half of
+the BENCH_r05 int4 regression). Non-conforming shapes still pad and stay
+correct. Shapes are picked per (rows, in, out, dtype) and memoized
+through ``framework.compile_cache.memoize_kernel_choice`` so a warm
+server never retunes mid-flight. On non-TPU backends the kernel runs in
+Pallas interpret mode (exact, slow) — CI covers it; dispatch policy
+lives in ``nn/quant.py``.
 """
 from __future__ import annotations
 
@@ -70,27 +75,54 @@ def unpack_int4(packed):
 # ------------------------------------------------------- block selection
 
 
+# VMEM budget for ONE weight block: leave room for double-buffered
+# operand prefetch, the activation block and the f32 accumulator inside
+# the ~16 MB VMEM envelope
+_WEIGHT_BLOCK_BYTES = 4 << 20
+
+
 def select_block_shapes(rows, k, n, weight_dtype):
     """(bk, bn) for the fused kernel, memoized per problem shape.
 
-    bn: widest of {512, 256, 128} lanes that the (padded) output is not
-    dominated by — wide n blocks amortize the scale/bias epilogue and the
-    revisit of the f32 accumulator. bk: deep K stripes keep the MXU fed
-    between epilogues while the [bk, bn] int8 block (bk//2 bytes for
-    int4) stays small next to the ~16 MB VMEM budget; shallow K problems
-    collapse to one k step.
+    Divisor-aware (ISSUE 9 tentpole c): a block that does not divide the
+    problem pads the WEIGHT outside the kernel — a materialized copy
+    whose write+read costs more than the bandwidth the quantization
+    saved (GPT's 768/2304-wide layers padded to 1024-multiples under the
+    old widest-block-that-fits rule). So: ``bn`` is the widest of
+    {512, 256, 128} lanes dividing n (wide blocks amortize the
+    scale/bias epilogue), falling back to widest-that-fits for
+    non-conforming n; ``bk`` is the WHOLE K dimension when the weight
+    block fits the VMEM budget and K is lane-tileable — one accumulator
+    pass, zero epilogue revisits, and the packed int4 block is half the
+    int8 bytes so it goes twice as deep — else the deepest power-of-two
+    stripe dividing k, else the old pad-up heuristic.
     """
     def compute():
-        bn = 128
-        for cand in (512, 256):
-            if n >= cand:
-                bn = cand
-                break
-        bk = 128
-        for cand in (1024, 512, 256):
-            if k >= cand:
-                bk = cand
-                break
+        bn = next((c for c in (512, 256, 128) if n % c == 0), None)
+        if bn is None:
+            bn = 128
+            for cand in (512, 256):
+                if n >= cand:
+                    bn = cand
+                    break
+        # bytes one K row of the weight block costs in VMEM (packed
+        # nibbles store two K rows per byte row)
+        per_row = bn if weight_dtype == "int8" else bn // 2
+        # whole-K needs the activation block's minor dim (bk for int8,
+        # bk//2 for the int4 even/odd halves) to stay a 128-lane multiple
+        lane_mult = 128 if weight_dtype == "int8" else 256
+        if k % lane_mult == 0 and k * per_row <= _WEIGHT_BLOCK_BYTES:
+            bk = k
+        else:
+            bk = next((c for c in (2048, 1024, 512, 256)
+                       if k % c == 0 and c * per_row
+                       <= _WEIGHT_BLOCK_BYTES), None)
+            if bk is None:
+                bk = 128
+                for cand in (1024, 512, 256):
+                    if k >= cand:
+                        bk = cand
+                        break
         return bk, bn
 
     return memoize_kernel_choice(
@@ -130,13 +162,17 @@ def _int4_kernel(xe_ref, xo_ref, w_ref, s_ref, *rest, grid_k):
     def _():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # one load of the packed bytes; both nibbles dequant in VMEM
+    # ONE load of the packed bytes; both nibbles dequant in VMEM into a
+    # single [bk, bn] slab — low-nibble rows stacked over high-nibble
+    # rows (a tile-aligned sublane concat, not an interleave Mosaic
+    # would relayout), contracted by ONE full-depth MXU dot against the
+    # activation's matching (even ‖ odd) K-column halves
     w = w_ref[:].astype(jnp.int32)  # [bk//2, bn]
-    lo = jnp.right_shift(jnp.left_shift(w, 28), 28).astype(xe_ref.dtype)
-    hi = jnp.right_shift(w, 4).astype(xe_ref.dtype)
-    acc_ref[:] += (
-        jnp.dot(xe_ref[:], lo, preferred_element_type=jnp.float32)
-        + jnp.dot(xo_ref[:], hi, preferred_element_type=jnp.float32))
+    lo = jnp.right_shift(jnp.left_shift(w, 28), 28)
+    hi = jnp.right_shift(w, 4)
+    slab = jnp.concatenate([lo, hi], axis=0).astype(xe_ref.dtype)
+    x = jnp.concatenate([xe_ref[:], xo_ref[:]], axis=1)  # [rows, bk]
+    acc_ref[:] += jnp.dot(x, slab, preferred_element_type=jnp.float32)
     _epilogue(k_step, grid_k, acc_ref, s_ref, b_ref, o_ref)
 
 

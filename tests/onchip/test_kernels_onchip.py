@@ -212,6 +212,75 @@ class TestPagedOnChip:
         assert _err(got, want) < 5e-2
 
 
+class TestVerifySlabOnChip:
+    """Mosaic-lowered fused verify/suffix slab attention (ISSUE 9) vs
+    the jnp window-gather reference, plus the dispatch-shape contract:
+    the verify path is ONE pallas_call with ZERO gathers."""
+
+    def _state(self, rng, B, HKV, D, PS, NP, MAXP, quantized=False):
+        from paddle_tpu.ops.pallas.paged_attention import PagedCacheState
+
+        if quantized:
+            kp = jnp.asarray(rng.integers(-127, 128, (NP, PS, HKV * D)),
+                             jnp.int8)
+            vp = jnp.asarray(rng.integers(-127, 128, (NP, PS, HKV * D)),
+                             jnp.int8)
+            sc = (jnp.zeros((NP, PS, 128), jnp.bfloat16)
+                  .at[..., :2 * HKV].set(jnp.asarray(
+                      rng.random((NP, PS, 2 * HKV)) * 0.05 + 0.02,
+                      jnp.bfloat16)))
+        else:
+            kp = jnp.asarray(rng.standard_normal((NP, PS, HKV * D)),
+                             jnp.bfloat16)
+            vp = jnp.asarray(rng.standard_normal((NP, PS, HKV * D)),
+                             jnp.bfloat16)
+            sc = None
+        bt = np.zeros((B, MAXP), np.int32)
+        pool = list(range(1, NP))
+        for b in range(B):
+            for j in range(MAXP):
+                bt[b, j] = pool.pop(int(rng.integers(0, len(pool))))
+        return PagedCacheState(kp, vp, sc, jnp.asarray(bt),
+                               jnp.zeros((B,), jnp.int32), PS)
+
+    @pytest.mark.parametrize("quantized", [False, True])
+    @pytest.mark.parametrize("m", [5, 32])
+    def test_kernel_matches_window_gather_ref(self, rng, m, quantized):
+        from paddle_tpu.ops.pallas.paged_attention import (
+            _interpret, _paged_multi_query_ref,
+            paged_verify_slab_attention)
+
+        assert not _interpret()
+        B, H, HKV, D, PS, NP, MAXP = 8, 12, 4, 64, 16, 220, 24
+        st = self._state(rng, B, HKV, D, PS, NP, MAXP,
+                         quantized=quantized)
+        base = jnp.asarray(rng.integers(0, MAXP * PS - m, (B,)), jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, m, H, D)), jnp.bfloat16)
+        got = paged_verify_slab_attention(
+            q, st.k_pages, st.v_pages, st.block_tables, base,
+            scale_pages=st.scale_pages)
+        want = _paged_multi_query_ref(q, st, base)
+        assert _err(got, want) < 5e-2
+
+    def test_verify_path_is_one_pallas_call_zero_gathers(self, rng):
+        """On TPU `paged_multi_query_attention` (the entry spec verify,
+        suffix prefill and chunked prefill all ride) must lower to ONE
+        pallas_call and no XLA gather — the window-gather twin is gone
+        from the hot path."""
+        from paddle_tpu.ops.pallas.paged_attention import (
+            paged_multi_query_attention)
+
+        B, H, HKV, D, PS, NP, MAXP = 4, 12, 4, 64, 16, 120, 8
+        st = self._state(rng, B, HKV, D, PS, NP, MAXP)
+        base = jnp.asarray([9, 0, 40, 100], jnp.int32)
+        q = jnp.asarray(rng.standard_normal((B, 5, H, D)), jnp.bfloat16)
+        jaxpr = jax.make_jaxpr(
+            lambda q, bl: paged_multi_query_attention(q, st, bl))(q, base)
+        prims = [e.primitive.name for e in jaxpr.jaxpr.eqns]
+        assert prims.count("pallas_call") == 1, prims
+        assert "gather" not in prims, prims
+
+
 class TestQuantMatmulOnChip:
     """Mosaic-lowered fused weight-only matmul vs the plain-XLA
     dequant-dot reference (a nibble-shift or epilogue lowering bug must

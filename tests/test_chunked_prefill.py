@@ -1,0 +1,212 @@
+"""Chunked prefill suite (ISSUE 9 tentpole b): ``Engine(prefill_chunk=N)``
+streams prompts into the paged cache N tokens per mixed chunk+decode step
+instead of one bucketed prefill dispatch.
+
+The load-bearing invariant, asserted throughout (riding the PR 6/8
+batchmate-identity harnesses): every request's output tokens are
+IDENTICAL chunked on vs off — greedy and temperature>0, spec on and off,
+prefix cache on and off, with eos termination, under page-pool pressure
+(preemption mid-prefill) and injected per-request faults. On top of
+that: the sampled-key burn stays one-draw-per-delivered-token (the emit
+gate), pages allocate chunk-by-chunk and never leak, and the chunk /
+slab-dispatch counters are scrape-visible. Runs on CPU as part of
+tier-1 (``make chaos``)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import metric_total, render_prometheus
+
+PAGE = 8
+PLENS = (20, 24, 18, 9, 22)
+BUDGET = 10
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                    max_position=128, vocab_size=97)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def make_engine(gpt, chunk=None, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", PAGE)
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("dtype", jnp.float32)
+    return Engine(gpt, prefill_chunk=chunk, **kw)
+
+
+def prompts(plens=PLENS, vocab=97):
+    r = np.random.default_rng(0)
+    return [r.integers(0, vocab, (n,)) for n in plens]
+
+
+def serve(eng, temp=0.0, plens=PLENS, budget=BUDGET, expect_ok=True):
+    reqs = [eng.add_request(p, budget, temperature=temp, seed=11 + i)
+            for i, p in enumerate(prompts(plens))]
+    eng.run()
+    if expect_ok:
+        assert all(r.done and not r.failed for r in reqs), \
+            [(r.failure_reason, r.failure) for r in reqs]
+    return reqs
+
+
+def tokens(reqs):
+    return [list(r.tokens) for r in reqs]
+
+
+def assert_pages_conserved(eng):
+    """Every page is free or table-referenced exactly refcount times —
+    chunk-by-chunk allocation must not leak a page anywhere."""
+    free = eng._free_pages
+    assert len(set(free)) == len(free), "duplicate free pages"
+    refs = np.zeros_like(eng._page_ref)
+    for row in eng.tables:
+        for p in row:
+            if p:
+                refs[int(p)] += 1
+    assert np.array_equal(refs, eng._page_ref), "refcounts drifted"
+    cached = set(eng._pcache._by_page) if eng._pcache is not None else set()
+    assert set(free) | cached | {int(p) for row in eng.tables
+                                 for p in row if p} \
+        == set(range(1, eng.num_pages)), "pages leaked"
+    assert not eng._chunk_left, "mid-prefill state survived the drain"
+
+
+@pytest.fixture(scope="module")
+def clean(gpt):
+    """Chunk-OFF baseline token streams (greedy), by request index."""
+    out = tokens(serve(make_engine(gpt)))
+    out2 = tokens(serve(make_engine(gpt)))
+    assert out == out2  # chunk-off determinism
+    return out
+
+
+class TestChunkedIdentity:
+    @pytest.mark.parametrize("chunk", [2, 4, 32])
+    def test_greedy_identical_across_chunk_sizes(self, gpt, clean, chunk):
+        """Chunk crossing page boundaries, matching them, and swallowing
+        whole prompts all reproduce the unchunked stream bit-for-bit."""
+        eng = make_engine(gpt, chunk=chunk)
+        assert tokens(serve(eng)) == clean
+        assert_pages_conserved(eng)
+
+    def test_sampled_identical(self, gpt):
+        """temperature>0: the emit gate burns exactly one draw per
+        delivered token, so sampled streams match chunked on vs off."""
+        base = tokens(serve(make_engine(gpt), temp=0.8))
+        assert tokens(serve(make_engine(gpt, chunk=4), temp=0.8)) == base
+
+    def test_spec_greedy_identical(self, gpt, clean):
+        """Spec decode + chunked prefill: prompts stream through mixed
+        steps, then spec verify takes over — greedy output unchanged."""
+        eng = make_engine(gpt, chunk=8, spec="ngram", spec_k=4)
+        assert tokens(serve(eng)) == clean
+
+    def test_prefix_cache_identical_and_hits(self, gpt, clean):
+        """Prefix cache + chunking: splices shrink the first chunk's
+        work, chunk completion registers the prompt — two waves through
+        one engine match the baseline and the second wave hits."""
+        eng = make_engine(gpt, chunk=4, prefix_cache=True)
+        assert tokens(serve(eng)) == clean
+        assert tokens(serve(eng)) == clean  # warm-cache wave
+        assert eng._pcache.hits >= 4
+        assert_pages_conserved(eng)
+
+    def test_eos_identical(self, gpt):
+        """eos mid-stream terminates at the same token chunked or not
+        (and the chained path's straggler clamp coexists with mixed
+        admission)."""
+        base = tokens(serve(make_engine(gpt)))
+        eos = base[0][2]  # a token greedy decode will actually produce
+        off = tokens(serve(make_engine(gpt, eos_id=eos), budget=16))
+        on = tokens(serve(make_engine(gpt, chunk=4, eos_id=eos),
+                          budget=16))
+        assert on == off
+        assert any(t[-1] == eos and len(t) < 16 for t in on)
+
+
+class TestChunkedPressure:
+    def test_preemption_mid_prefill_identical(self, gpt, clean):
+        """A pool too small for all prompts forces preemption while
+        prompts are mid-stream; the recompute policy re-chunks from
+        scratch and outputs still match the ample-pool baseline."""
+        eng = make_engine(gpt, chunk=4, num_pages=20)
+        reqs = serve(eng)
+        assert tokens(reqs) == clean
+        assert_pages_conserved(eng)
+
+    def test_preemption_mid_prefill_sampled(self, gpt):
+        """Sampled + pressure: a preempted mid-prefill request must not
+        have burned any draws (emit gate), so its resumed stream matches
+        the unpressured run exactly."""
+        base = tokens(serve(make_engine(gpt), temp=0.7))
+        eng = make_engine(gpt, chunk=4, num_pages=20)
+        assert tokens(serve(eng, temp=0.7)) == base
+
+    def test_long_prompts_many_chunks(self, gpt):
+        """Prompts spanning many chunks and pages (the workload chunking
+        exists for) still match the unchunked stream."""
+        plens = (70, 101, 55)
+        base = tokens(serve(make_engine(gpt), plens=plens, budget=6))
+        eng = make_engine(gpt, chunk=8)
+        assert tokens(serve(eng, plens=plens, budget=6)) == base
+        assert metric_total("paddle_tpu_prefill_chunks_total") > 0
+
+
+class TestChunkedFaults:
+    def test_injected_fault_isolates_one_request(self, gpt, clean):
+        """A step-exception fired at one request's mixed-step harvest
+        fails THAT request; batchmates stay identical to the fault-free
+        run (the PR 6 batchmate-identity contract)."""
+        eng = make_engine(gpt, chunk=4,
+                          fault_plan="step-exception:rid=1,times=1")
+        reqs = serve(eng, expect_ok=False)
+        assert reqs[1].failed and reqs[1].failure_reason == "step_fault"
+        assert all(r.done and not r.failed
+                   for i, r in enumerate(reqs) if i != 1)
+        assert [list(r.tokens) for i, r in enumerate(reqs) if i != 1] \
+            == [t for i, t in enumerate(clean) if i != 1]
+        assert_pages_conserved(eng)
+
+    def test_nan_injection_isolates(self, gpt, clean):
+        eng = make_engine(gpt, chunk=4,
+                          fault_plan="nan-logits:rid=2,times=1")
+        reqs = serve(eng, expect_ok=False)
+        assert reqs[2].failed and reqs[2].failure_reason == "nan_logits"
+        assert [list(r.tokens) for i, r in enumerate(reqs) if i != 2] \
+            == [t for i, t in enumerate(clean) if i != 2]
+
+
+class TestChunkedSurface:
+    def test_validation(self, gpt):
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            make_engine(gpt, chunk=1)
+        with pytest.raises(ValueError, match="prefill_chunk"):
+            make_engine(gpt, chunk=1000)
+
+    def test_counters_scrape_visible(self, gpt):
+        eng = make_engine(gpt, chunk=4)
+        serve(eng)
+        text = render_prometheus()
+        assert "paddle_tpu_prefill_chunks_total" in text
+        assert 'paddle_tpu_slab_verify_dispatch_total{path=' \
+               '"chunked_prefill"}' in text
+        assert metric_total("paddle_tpu_slab_verify_dispatch_total") > 0
+
+    def test_compile_surface_is_flat(self, gpt):
+        """The chunked engine's prompt-side compile surface is ONE mixed
+        program (per sampling flag) regardless of prompt-length spread —
+        the property that closes the first-wave gap."""
+        eng = make_engine(gpt, chunk=4)
+        serve(eng, plens=(9, 20, 33, 50, 64))
+        assert len(eng._mixed_fns) == 1
+        assert len(eng._prefill_fns) == 0

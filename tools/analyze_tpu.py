@@ -256,6 +256,68 @@ def _spec_verify_step():
     return fn, args, {"donate_argnums": (1,)}
 
 
+def _verify_slab_attention():
+    """The fused verify/suffix slab kernel (ISSUE 9 tentpole a), traced
+    through its interpret-mode pallas_call so liveness/cost see the real
+    kernel boundary (the cost pass counts a pallas_call's operand/result
+    traffic — the pages stream once, which IS the kernel's byte model)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.paged_attention import (
+        paged_verify_slab_attention)
+
+    rng = np.random.default_rng(0)
+    B, m, H, HKV, D, PS, MAXP = 4, 5, 4, 2, 64, 16, 8
+    kp = jnp.asarray(rng.standard_normal((1 + B * MAXP, PS, HKV * D)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((1 + B * MAXP, PS, HKV * D)),
+                     jnp.float32)
+    bt = jnp.asarray(np.arange(1, 1 + B * MAXP,
+                               dtype=np.int32).reshape(B, MAXP))
+    base = jnp.asarray([9, 0, 40, 100], jnp.int32)
+    q = jnp.asarray(rng.standard_normal((B, m, H, D)), jnp.float32)
+
+    def verify_slab_attention(q, kp, vp, bt, base):
+        return paged_verify_slab_attention(q, kp, vp, bt, base,
+                                           interpret=True)
+
+    return verify_slab_attention, [q, kp, vp, bt, base], {}
+
+
+def _chunked_prefill_step():
+    """The mixed chunk+decode step (ISSUE 9 tentpole b): one fixed-shape
+    program advancing prefilling rows by a chunk and decoding rows by
+    one token through the verify/suffix attention path, traced exactly
+    as the engine jits it (pages donated)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import Engine, make_mixed_step_fn
+    from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(tiny_llama_config())
+    model.eval()
+    eng = Engine(model, max_slots=2, num_pages=32, page_size=8,
+                 chunk_size=4, dtype=jnp.float32, prefill_chunk=4)
+    nb, chunk = 2, 4
+    fn = make_mixed_step_fn(eng, sampling=False)
+    fn.__name__ = "chunked_prefill_step"
+    tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+    tables[:, :2] = [[1, 2], [3, 4]]
+    ids = np.zeros((nb, chunk), np.int32)
+    args = [eng._params, eng._pages_flat(), jnp.asarray(ids),
+            jnp.asarray(np.array([4, 1], np.int32)),   # widths: chunk+decode
+            jnp.asarray(np.array([0, 1], np.int32)),   # emit
+            jnp.asarray(tables),
+            jnp.asarray(np.array([3, 9], np.int32)),   # lengths
+            jnp.zeros((nb,), jnp.float32),             # temps
+            jnp.zeros((nb, 2), jnp.uint32)]            # keys
+    return fn, args, {"donate_argnums": (1,)}
+
+
 ENTRIES: List[Entry] = [
     Entry("llama_decode_step", _llama_decode_step,
           "serving decode: one token through the slab KV cache"),
@@ -272,6 +334,10 @@ ENTRIES: List[Entry] = [
           "shard_map data-parallel step (collective pass coverage)"),
     Entry("spec_verify_step", _spec_verify_step,
           "spec-decode verify: k+1 positions + acceptance, paged path"),
+    Entry("verify_slab_attention", _verify_slab_attention,
+          "fused verify/suffix slab kernel (pallas_call boundary)"),
+    Entry("chunked_prefill_step", _chunked_prefill_step,
+          "mixed chunk+decode step: chunked prefill + width-1 decode"),
 ]
 
 

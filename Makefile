@@ -22,7 +22,8 @@ lint:
 	python tools/lint_tpu.py paddle_tpu examples tools --fail-on-violation
 
 analyze:
-	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation
+	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation \
+		--mesh 1 --mesh 4 --mesh 8
 
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \

@@ -499,6 +499,40 @@ def bench_resume(on_tpu):
         return {"resume_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_multichip():
+    """Multichip comm-roofline drift (ISSUE 10): the TP step measured
+    vs the tpushard-predicted step time, via tools/multichip.py in a
+    fresh subprocess (it forces the virtual-8-device mesh without
+    perturbing THIS process's device topology). Records the
+    predicted-vs-measured ratio the TPC601 advisory is gated on (the
+    same convention as the decode _cost_ratio lines from ISSUE 4)."""
+    import os
+    import subprocess
+
+    try:
+        tool = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "multichip.py")
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)  # let the tool pick its own topology
+        env["JAX_PLATFORMS"] = "cpu"
+        proc = subprocess.run(
+            [sys.executable, tool, "--tp-only", "--json"],
+            capture_output=True, text=True, timeout=600, env=env)
+        payload = json.loads(proc.stdout.strip().splitlines()[-1])
+        tp = payload["tp_step"]
+        return {
+            "multichip_tp_step_ms": tp["measured_step_ms"],
+            "multichip_tp_pred_ms": tp["predicted_step_ms"],
+            "multichip_comm_fraction_measured":
+                tp["comm_fraction_measured"],
+            "multichip_comm_fraction_pred":
+                tp["comm_fraction_predicted"],
+            "multichip_pred_vs_measured": tp["pred_vs_measured"],
+        }
+    except Exception as e:
+        return {"multichip_error": f"{type(e).__name__}: {e}"}
+
+
 def main():
     from paddle_tpu.framework.compile_cache import enable_compilation_cache
     from paddle_tpu.models.gpt import GPTConfig
@@ -543,6 +577,7 @@ def main():
     fault = bench_fault(decode_cfg, on_tpu)
     prefix = bench_prefix(decode_cfg, on_tpu)
     resume = bench_resume(on_tpu)
+    multichip = bench_multichip()
 
     # observability snapshot (ISSUE 3): the perf trajectory carries the
     # telemetry the run produced — how many programs compiled, whether
@@ -619,6 +654,10 @@ def main():
             metric_total("paddle_tpu_train_preemptions_total")),
         "train_resumes": int(
             metric_total("paddle_tpu_train_resumes_total")),
+        # multichip comm-roofline drift (ISSUE 10): TPC601's predicted
+        # TP step vs the measured one (tools/multichip.py subprocess)
+        "multichip_pred_vs_measured": multichip.get(
+            "multichip_pred_vs_measured", 0.0),
     }
 
     out = {
@@ -649,6 +688,7 @@ def main():
         **fault,
         **prefix,
         **resume,
+        **multichip,
         "metrics": metrics_block,
     }
     print(json.dumps(out))

@@ -19,15 +19,24 @@ value-dependent-control-flow depth. Three checks:
   jax traces violations without complaint (verified on 0.4.37); the
   chip hangs or silently drops data.
 
-``pbroadcast`` eqns are exempt from TPC202: shard_map's replication
-rewrite inserts them mechanically and they lower to no communication.
+``pbroadcast`` and ``axis_index`` eqns are exempt from TPC202 (the
+``_BLOCKING`` subset below): shard_map's replication rewrite inserts
+``pbroadcast`` mechanically, and ``axis_index`` lowers to a local
+partition-id read — neither blocks on peers, so per-shard index math
+under a value-dependent ``cond`` is NOT a deadlock shape. Both stay in
+``COLLECTIVE_PRIMS`` on purpose: they still NAME an axis, so TPC201's
+axis-vs-mesh check must see them (an ``axis_index('mp')`` against a
+mesh with no ``mp`` is the same written-for-another-mesh bug as a
+``psum``). Regression fixture:
+``tests/fixtures/analysis/coll_axis_index_cond.py``.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
-from .core import Finding, PassContext, eqn_source, subjaxprs, _raw
+from .core import (Finding, PassContext, eqn_source, mesh_axis_sizes,
+                   subjaxprs, _raw)
 from . import rules as R
 
 __all__ = ["CollectivePass", "COLLECTIVE_PRIMS"]
@@ -77,17 +86,8 @@ class CollectivePass:
     name = "collectives"
 
     def run(self, ctx: PassContext, report) -> None:
-        mesh_axes: Dict[str, Optional[int]] = {}
-        self._mesh_axis_names: Set[str] = set()
-        if ctx.mesh is not None:
-            try:
-                mesh_axes = {str(n): int(s) for n, s in
-                             zip(ctx.mesh.axis_names,
-                                 ctx.mesh.devices.shape)}
-            except Exception:
-                mesh_axes = {str(n): None
-                             for n in getattr(ctx.mesh, "axis_names", ())}
-            self._mesh_axis_names = set(mesh_axes)
+        mesh_axes: Dict[str, Optional[int]] = mesh_axis_sizes(ctx.mesh)
+        self._mesh_axis_names: Set[str] = set(mesh_axes)
         self._ctx = ctx
         self._report = report
         self._walk(_raw(ctx.closed), _Scope(dict(mesh_axes)))
@@ -104,13 +104,7 @@ class CollectivePass:
         """Axes a shard_map/pmap eqn binds, with sizes where known."""
         prim = eqn.primitive.name
         if prim == "shard_map":
-            mesh = eqn.params.get("mesh")
-            try:
-                axes = {str(n): int(s) for n, s in
-                        zip(mesh.axis_names, mesh.devices.shape)}
-            except Exception:
-                axes = {str(n): None
-                        for n in getattr(mesh, "axis_names", ())}
+            axes = mesh_axis_sizes(eqn.params.get("mesh"))
             auto = eqn.params.get("auto") or frozenset()
             binder = {n: s for n, s in axes.items() if n not in auto}
             # the binder's mesh must itself agree with the active mesh

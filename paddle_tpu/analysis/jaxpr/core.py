@@ -27,7 +27,7 @@ from .rules import JRULES, JaxprRule
 __all__ = [
     "Finding", "AnalysisReport", "FlatOp", "VarRec", "FlatProgram",
     "flatten", "bytes_of_aval", "analyze_jaxpr", "analyze_fn",
-    "DEFAULT_PASSES", "eqn_source",
+    "DEFAULT_PASSES", "eqn_source", "mesh_axis_sizes",
 ]
 
 
@@ -69,6 +69,7 @@ class AnalysisReport:
     findings: List[Finding] = field(default_factory=list)
     memory: Optional[Any] = None    # liveness.MemoryEstimate
     cost: Optional[Any] = None      # cost.CostRollup
+    comm: Optional[Any] = None      # comm.CommEstimate
     passes_run: Tuple[str, ...] = ()
 
     def by_severity(self, *levels: str) -> List[Finding]:
@@ -137,6 +138,27 @@ def bytes_of_aval(aval) -> int:
         except TypeError:
             return 0  # symbolic dim (export) — no concrete size
     return n * itemsize
+
+
+def mesh_axis_sizes(mesh) -> Dict[str, Optional[int]]:
+    """``{axis_name: size}`` for a concrete ``Mesh`` OR an
+    ``AbstractMesh`` (the device-free tracing mesh ``--mesh N`` sweeps
+    use). Sizes are ``None`` only when the mesh exposes names but no
+    shape at all — every pass treats an unknown size as "don't gate"."""
+    if mesh is None:
+        return {}
+    shape = getattr(mesh, "shape", None)
+    if shape is not None and hasattr(shape, "items"):
+        # Mesh.shape and AbstractMesh.shape are both name->size mappings
+        try:
+            return {str(n): int(s) for n, s in shape.items()}
+        except Exception:
+            pass
+    try:
+        return {str(n): int(s) for n, s in
+                zip(mesh.axis_names, mesh.devices.shape)}
+    except Exception:
+        return {str(n): None for n in getattr(mesh, "axis_names", ())}
 
 
 def eqn_source(eqn) -> str:
@@ -396,14 +418,17 @@ def materialize(prog: FlatProgram) -> None:
 
 
 def _default_passes():
-    from . import collectives, cost, donation, liveness
+    from . import collectives, comm, cost, donation, liveness, sharding
 
+    # cost must run before comm (the comm pass reads report.cost for the
+    # compute side of the comm/compute comparison)
     return (liveness.LivenessPass(), collectives.CollectivePass(),
-            donation.DonationPass(), cost.CostModelPass())
+            sharding.ShardingPass(), donation.DonationPass(),
+            cost.CostModelPass(), comm.CommCostPass())
 
 
-DEFAULT_PASSES: Tuple[str, ...] = ("liveness", "collectives", "donation",
-                                   "cost")
+DEFAULT_PASSES: Tuple[str, ...] = ("liveness", "collectives", "sharding",
+                                   "donation", "cost", "comm")
 
 
 def analyze_jaxpr(closed, *, entry: str = "<jaxpr>",
@@ -413,7 +438,8 @@ def analyze_jaxpr(closed, *, entry: str = "<jaxpr>",
                   device_kind: Optional[str] = None,
                   passes=None,
                   top_k: int = 5,
-                  min_donation_bytes: int = 1 << 20) -> AnalysisReport:
+                  min_donation_bytes: int = 1 << 20,
+                  min_sharding_bytes: int = 1 << 20) -> AnalysisReport:
     """Run the tpucheck passes over a traced program.
 
     ``mesh``: the mesh the program is expected to run under (defaults to
@@ -436,7 +462,8 @@ def analyze_jaxpr(closed, *, entry: str = "<jaxpr>",
     ctx = PassContext(closed=closed, entry=entry, mesh=mesh,
                       donate_argnums=tuple(donate_argnums),
                       budget_bytes=budget_bytes, device_kind=device_kind,
-                      top_k=top_k, min_donation_bytes=min_donation_bytes)
+                      top_k=top_k, min_donation_bytes=min_donation_bytes,
+                      min_sharding_bytes=min_sharding_bytes)
     for p in passes:
         p.run(ctx, report)
     report.findings.sort(key=lambda f: (SEV_ORDER[f.severity], f.rule,
@@ -458,6 +485,9 @@ class PassContext:
     top_k: int = 5
     # TPC302 advisory floor: donating a KB-scale buffer is noise
     min_donation_bytes: int = 1 << 20
+    # TPC501/502/503 floor: replicating/resharding/gathering a KB-scale
+    # buffer is noise; a MiB-scale one is a parameter
+    min_sharding_bytes: int = 1 << 20
     _flat: Optional[FlatProgram] = None
 
     @property
@@ -474,12 +504,19 @@ def analyze_fn(fn: Callable, *args,
                donate_argnums: Sequence[int] = (),
                static_argnums: Sequence[int] = (),
                entry: Optional[str] = None,
+               check_processes: int = 0,
                **analyze_kw) -> AnalysisReport:
     """Trace ``fn(*args)`` with ``jax.make_jaxpr`` and analyze it.
 
     ``donate_argnums`` uses the *python argument* positions (like
     ``jax.jit``); they are expanded to flat-leaf positions so pytree
     arguments donate every leaf, matching jit semantics.
+
+    ``check_processes``: when > 0, additionally re-trace ``fn`` under
+    each simulated process identity (``jax.process_index`` patched to
+    0..n-1) and append a TPC510 finding if the traces differ — the
+    multi-host divergence detector (see :mod:`divergence`). The main
+    report is always built from the process-0 trace.
     """
     import jax
 
@@ -496,8 +533,18 @@ def analyze_fn(fn: Callable, *args,
             if i in set(donate_argnums):
                 donated_flat.extend(range(flat_pos, flat_pos + nleaves))
             flat_pos += nleaves
-    return analyze_jaxpr(
+    report = analyze_jaxpr(
         closed,
         entry=entry or getattr(fn, "__name__", "<fn>"),
         donate_argnums=donated_flat,
         **analyze_kw)
+    if check_processes and check_processes > 1:
+        from .divergence import check_host_divergence
+
+        report.findings.extend(check_host_divergence(
+            fn, args, n_processes=check_processes,
+            static_argnums=tuple(static_argnums), entry=report.entry,
+            baseline=closed))
+        report.findings.sort(key=lambda f: (SEV_ORDER[f.severity], f.rule,
+                                            f.op_index))
+    return report

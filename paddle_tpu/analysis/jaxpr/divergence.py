@@ -1,0 +1,186 @@
+"""tpushard multi-host divergence detector (TPC510).
+
+TPC202 sees collectives under value-dependent ``cond``/``while`` — the
+divergence the *tracer* can represent. The other half of the hazard
+lives ABOVE the trace: host-side Python that branches on a per-process
+value (``jax.process_index()``, a per-host flag) while *building* the
+program. Every process then compiles a different program, and the first
+collective deadlocks — nothing in any single jaxpr is wrong, so no
+per-jaxpr pass can see it.
+
+It is still decidable from the program alone: trace the entry point
+once per simulated process identity (``jax.process_index`` patched to
+0 and n-1, ``jax.process_count`` to n) and compare the traces. Two
+kinds of divergence are reported:
+
+* **structural** — the primitive sequence or result shapes differ
+  (some process built extra ops: the deadlock shape);
+* **constant** — same structure, but a closure constant differs (a
+  per-process value was baked into the program: silent numeric
+  divergence, e.g. a loss scaled by the process index).
+
+The source-level sibling is tpulint's TPL801 (``process_index()``
+guarding a collective/checkpoint commit without a barrier): TPL801
+sees the *pattern* in any module; TPC510 proves the *consequence* on a
+concrete entry point.
+"""
+from __future__ import annotations
+
+import hashlib
+from contextlib import contextmanager
+from typing import Any, List, Optional, Sequence, Tuple
+
+from . import rules as R
+from .core import Finding, subjaxprs, _raw
+
+__all__ = ["check_host_divergence", "trace_signature"]
+
+
+def trace_signature(closed) -> List[Tuple[str, Tuple[str, ...],
+                                          Tuple[str, ...]]]:
+    """Order-stable structural signature of a (closed) jaxpr: one
+    ``(primitive, result avals, literal operands)`` row per eqn,
+    recursing into every sub-jaxpr. Literal operand VALUES are part of
+    the signature — a per-process scalar baked into an eqn (``x *
+    (process_index()+1)``) is program divergence even though the shape
+    is identical."""
+    from jax._src.core import Literal
+
+    rows: List[Tuple[str, Tuple[str, ...], Tuple[str, ...]]] = []
+
+    def lit(v) -> Optional[str]:
+        if isinstance(v, Literal):
+            try:
+                return repr(getattr(v, "val", None))
+            except Exception:
+                return "<literal>"
+        return None
+
+    def walk(jaxpr):
+        for eqn in jaxpr.eqns:
+            rows.append((eqn.primitive.name,
+                         tuple(str(v.aval) for v in eqn.outvars),
+                         tuple(s for s in map(lit, eqn.invars)
+                               if s is not None)))
+            for _, sub in subjaxprs(eqn.params):
+                walk(_raw(sub))
+
+    walk(_raw(closed))
+    return rows
+
+
+def _const_digest(closed) -> List[str]:
+    """Per-const content digests (shape/dtype/bytes) — catches a
+    per-process value baked into the program as a closure constant."""
+    import numpy as np
+
+    out = []
+    for c in getattr(closed, "consts", ()) or ():
+        try:
+            arr = np.asarray(c)
+            h = hashlib.blake2b(arr.tobytes(), digest_size=8).hexdigest()
+            out.append(f"{arr.dtype}[{','.join(map(str, arr.shape))}]#{h}")
+        except Exception:
+            out.append(repr(type(c)))
+    return out
+
+
+@contextmanager
+def _process_identity(index: int, count: int):
+    """Patch ``jax.process_index``/``jax.process_count`` (restored on
+    exit). Entry points read them through the public module attrs, so a
+    module-level patch is sufficient for trace-time detection."""
+    import jax
+
+    saved = (jax.process_index, jax.process_count)
+    jax.process_index = lambda backend=None: index
+    jax.process_count = lambda backend=None: count
+    try:
+        yield
+    finally:
+        jax.process_index, jax.process_count = saved
+
+
+def check_host_divergence(fn, args: Sequence[Any], *, n_processes: int = 2,
+                          static_argnums: Tuple[int, ...] = (),
+                          entry: str = "<fn>",
+                          baseline=None) -> List[Finding]:
+    """Trace ``fn(*args)`` under process identities 0 and n-1 and return
+    TPC510 findings for any structural or constant divergence (empty
+    list = the host built the same program for every process)."""
+    import jax
+
+    identities = sorted({0, max(n_processes - 1, 0)})
+    traces: List[Tuple[int, Optional[Any], Optional[str]]] = []
+    for pidx in identities:
+        with _process_identity(pidx, n_processes):
+            # a FRESH wrapper per identity: jax caches traces by function
+            # identity + avals, and a cache hit would replay the other
+            # identity's program instead of re-running the host code
+            def fresh(*a, _fn=fn):
+                return _fn(*a)
+
+            try:
+                closed = jax.make_jaxpr(
+                    fresh, static_argnums=static_argnums)(*args)
+                traces.append((pidx, closed, None))
+            except Exception as e:  # trace itself diverged into a crash
+                traces.append((pidx, None, f"{type(e).__name__}: {e}"))
+
+    findings: List[Finding] = []
+    ref_pidx, ref_closed, ref_err = traces[0]
+    ref_sig = trace_signature(ref_closed) if ref_closed is not None else None
+    ref_consts = _const_digest(ref_closed) if ref_closed is not None else None
+    for pidx, closed, err in traces[1:]:
+        if (err is None) != (ref_err is None):
+            which = pidx if err is not None else ref_pidx
+            findings.append(Finding(
+                R.HOST_DIVERGENT_TRACE.id, "sharding",
+                f"tracing under process_index={which} raised "
+                f"({err or ref_err}) while the other identity traced "
+                f"fine — host code branches on the process identity",
+                entry=entry, data={"identities": identities,
+                                   "error": err or ref_err}))
+            continue
+        if err is not None:
+            continue  # both identities crash identically: not divergence
+        sig = trace_signature(closed)
+        if sig != ref_sig:
+            i = next((k for k, (a, b) in enumerate(zip(ref_sig, sig))
+                      if a != b), min(len(ref_sig), len(sig)))
+            a = ref_sig[i] if i < len(ref_sig) else ("<end>", (), ())
+            b = sig[i] if i < len(sig) else ("<end>", (), ())
+            if a[0] == b[0]:
+                where = (f"op {i} ({a[0]}) bakes different per-process "
+                         f"literals: {a[2]} vs {b[2]}")
+            else:
+                where = f"first divergence at op {i}: {a[0]} vs {b[0]}"
+            findings.append(Finding(
+                R.HOST_DIVERGENT_TRACE.id, "sharding",
+                f"process_index={ref_pidx} and {pidx} trace to "
+                f"different programs ({len(ref_sig)} vs "
+                f"{len(sig)} ops; {where}): in multi-controller SPMD "
+                f"every process must build the same program — hoist the "
+                f"per-process branch out of the traced entry",
+                entry=entry, op_index=i,
+                data={"identities": identities,
+                      "n_ops": [len(ref_sig), len(sig)],
+                      "first_divergence": i,
+                      "prims": [a[0], b[0]]}))
+            continue
+        consts = _const_digest(closed)
+        if consts != ref_consts:
+            i = next((k for k, (a, b) in
+                      enumerate(zip(ref_consts, consts)) if a != b),
+                     min(len(ref_consts), len(consts)))
+            findings.append(Finding(
+                R.HOST_DIVERGENT_TRACE.id, "sharding",
+                f"process_index={ref_pidx} and {pidx} build the same "
+                f"program shape but constant {i} differs "
+                f"({ref_consts[i] if i < len(ref_consts) else '<none>'} "
+                f"vs {consts[i] if i < len(consts) else '<none>'}): a "
+                f"per-process value is baked into the compiled program "
+                f"— thread it as an argument instead",
+                entry=entry,
+                data={"identities": identities, "const_index": i}))
+    return findings

@@ -20,6 +20,14 @@ Families (first digit):
   (missed in-place update).
 * ``4xx`` — cost model: roofline FLOPs/HBM-bytes rollup; dtype choices
   that fall off the TPU fast path.
+* ``5xx`` — sharding (tpushard): what the program actually does on a
+  mesh — implicit full replication of parameter-sized operands,
+  resharding copies at region boundaries, collectives whose operand
+  sharding degenerates them into no-ops or full materializations, and
+  host-side trace divergence across processes.
+* ``6xx`` — communication cost (tpushard): per-collective ICI roofline
+  over ring/torus cost formulas — predicted comm time, comm/compute
+  overlap fraction, and a predicted multichip step time.
 
 Severities: ``error`` findings are certainly wrong programs, ``warn``
 findings are hazards that need a justification to ship, ``info``
@@ -112,6 +120,52 @@ MEMORY_BOUND = _rule(
     "bandwidth-bound and the predicted-time model divides bytes by "
     "bandwidth, not FLOPs by peak. Expected for decode; a surprise for "
     "a train step.")
+
+IMPLICIT_FULL_REPLICATION = _rule(
+    "TPC501", "sharding", "implicit-full-replication", "warn",
+    "a parameter-sized operand (>= the replication floor, default 1MiB) "
+    "enters a shard_map region with an empty partition spec: every device "
+    "holds the FULL array. shard_map replicates whatever the in_spec does "
+    "not shard — silently, at trace time. For weights under tensor "
+    "parallelism this multiplies HBM by the mesh size and defeats the "
+    "sharding; shard the operand or justify the replication.")
+
+RESHARD_AT_BOUNDARY = _rule(
+    "TPC502", "sharding", "resharding-copy-at-boundary", "warn",
+    "a value produced by one manual region (shard_map out_spec) or "
+    "sharding constraint is consumed by another region under a DIFFERENT "
+    "spec: XLA inserts a resharding copy (gather + reslice over ICI) at "
+    "the jit boundary. The copy is invisible in the source and costs a "
+    "full tensor of ICI traffic per step; make the producer and consumer "
+    "specs agree, or reshard once outside the hot loop.")
+
+DEGENERATE_COLLECTIVE = _rule(
+    "TPC503", "sharding", "degenerate-or-materializing-collective", "warn",
+    "a collective's operand sharding makes it pathological: either every "
+    "named axis has size 1 on the bound mesh (the op lowers to a no-op "
+    "copy — the code was written for a different mesh factorization), or "
+    "an all-gather materializes a parameter-sized full tensor on every "
+    "device (the accidental full-weight all-gather; the psum-scatter "
+    "form keeps the result sharded and moves 1/n the bytes).")
+
+HOST_DIVERGENT_TRACE = _rule(
+    "TPC510", "sharding", "host-divergent-trace", "warn",
+    "tracing the program under different process identities "
+    "(jax.process_index 0 vs n-1) produces structurally different "
+    "programs: host-side Python branched on a per-process value while "
+    "building the trace. In multi-controller SPMD every process must "
+    "compile the SAME program; divergent traces deadlock at the first "
+    "collective (the host-side sibling of TPC202 — that rule sees "
+    "value-dependent cond/while, this one sees Python `if`).")
+
+COMM_BOUND = _rule(
+    "TPC601", "comm", "comm-bound-program", "info",
+    "advisory: the communication roofline predicts collective time "
+    "exceeding compute time after overlap — the program is ICI-bound at "
+    "this mesh shape. Expected for small per-device shards; a surprise "
+    "for a tensor-parallel train step. The finding carries predicted "
+    "comm/compute/step times and the overlap fraction (per-collective "
+    "ring/torus cost formulas; ICI peak tables in analysis/jaxpr/comm.py).")
 
 F64_COMPUTE = _rule(
     "TPC402", "cost", "float64-compute", "warn",

@@ -842,9 +842,100 @@ class _ModuleAnalyzer:
                       "distributed.checkpoint/serialization.save, or "
                       "stage ('tmp'/'stage' path) + os.replace")
 
+    # -- TPL801: process-identity guard around collective/commit -----------
+
+    _PROCESS_ID_CALLS = {"process_index", "process_count"}
+    _COLLECTIVE_CALLS = {
+        "all_reduce", "all_gather", "all_to_all", "broadcast",
+        "reduce_scatter", "psum", "psum_scatter", "pmean", "pmax", "pmin",
+        "ppermute", "pgather",
+    }
+    # inherently-checkpoint commit operations (no path-token gate needed)
+    _COMMIT_CALLS = {"save_state_dict", "write_manifest", "retain_last"}
+    # generic commit-ish tails that only count when the call expression
+    # mentions a checkpoint path (reuses TPL702's token hints)
+    _GENERIC_COMMIT_CALLS = {"save", "commit", "replace", "rename"}
+
+    @classmethod
+    def _is_barrier_call(cls, call: ast.Call) -> bool:
+        tail = _tail_name(call.func) or ""
+        return "barrier" in tail.lower() or tail == "sync_global_devices"
+
+    def _process_tainted_names(self, scope_node) -> Set[str]:
+        """Names bound from a process_index()/process_count() call
+        anywhere in the scope (``rank = jax.process_index()``)."""
+        names: Set[str] = set()
+        for n in ast.walk(scope_node):
+            if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call) \
+                    and _tail_name(n.value.func) in self._PROCESS_ID_CALLS:
+                for t in n.targets:
+                    names.update(_target_names(t))
+        return names
+
+    def _test_reads_process_identity(self, test: ast.AST,
+                                     tainted: Set[str]) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Call) \
+                    and _tail_name(n.func) in self._PROCESS_ID_CALLS:
+                return True
+            if isinstance(n, ast.Name) and n.id in tainted:
+                return True
+        return False
+
+    def _guarded_hazard(self, branch_stmts) -> Optional[str]:
+        """The first collective/commit call inside a guarded branch, as
+        a display string; None when the branch is benign."""
+        for stmt in branch_stmts:
+            for n in ast.walk(stmt):
+                if not isinstance(n, ast.Call):
+                    continue
+                tail = _tail_name(n.func)
+                if tail in self._COLLECTIVE_CALLS:
+                    return f"collective {tail}(...)"
+                if tail in self._COMMIT_CALLS:
+                    return f"checkpoint commit {tail}(...)"
+                if tail in self._GENERIC_COMMIT_CALLS:
+                    toks = " ".join(self._path_expr_tokens(a)
+                                    for a in list(n.args)
+                                    + [k.value for k in n.keywords])
+                    toks += " " + (_dotted(n.func) or "").lower()
+                    if any(h in toks for h in self._CKPT_PATH_HINTS):
+                        return f"checkpoint commit {tail}(...)"
+        return None
+
+    def _check_multihost_divergence(self):
+        """TPL801 — a branch on the process identity around work every
+        process must agree on. The barrier exemption is scope-wide: a
+        sync_global_devices/*barrier* call anywhere in the enclosing
+        function documents that the ranks re-converge."""
+        scopes = [self.tree] + [fi.node for fi in self.funcs]
+        for scope in scopes:
+            tainted = self._process_tainted_names(scope)
+            has_barrier = any(
+                isinstance(n, ast.Call) and self._is_barrier_call(n)
+                for n in ast.walk(scope))
+            if has_barrier:
+                continue
+            for n in _walk_shallow(scope) if scope is not self.tree \
+                    else ast.iter_child_nodes(scope):
+                if not isinstance(n, (ast.If, ast.While)):
+                    continue
+                if not self._test_reads_process_identity(n.test, tainted):
+                    continue
+                hazard = self._guarded_hazard(n.body) \
+                    or self._guarded_hazard(n.orelse)
+                if hazard is None:
+                    continue
+                self._add(R.MULTIHOST_DIVERGENT_GUARD, n,
+                          f"branch on the process identity guards a "
+                          f"{hazard} with no barrier in scope — ranks "
+                          f"outside the branch diverge from the ones "
+                          f"inside")
+
     def _check_module_wide(self):
         self._check_error_handling()
         self._check_ckpt_writes()
+        self._check_multihost_divergence()
         # TPL304: module-bound donating wrappers are callable from any
         # function below, so function scopes inherit the module's set
         module_wrappers = self._collect_donating_wrappers(self.tree)

@@ -27,6 +27,14 @@ Families (first digit of the numeric part):
   requires every caught failure to be re-raised or routed into the
   error taxonomy — a silently swallowed exception there is a request
   that never reaches FAILED and a metric that never moves.
+* ``8xx`` — multi-host divergence: host-side Python that branches on a
+  per-process identity (``jax.process_index()``/``process_count()``)
+  around code every process must agree on — a collective (the ranks
+  outside the branch never arrive: deadlock) or a checkpoint commit
+  (rank 0 commits while its peers race ahead: torn observability of
+  the commit point). The traced-program sibling is tpucheck's TPC510
+  (retrace-under-identities); this family sees the *pattern* in any
+  module, TPC510 proves the *consequence* on an entry point.
 """
 from __future__ import annotations
 
@@ -159,6 +167,19 @@ CKPT_WRITE_BYPASSES_COMMIT = _rule(
     "through `distributed.checkpoint.save_state_dict` / "
     "`serialization.save`, or write into a staging path "
     "('tmp'/'stage' in the name) and `os.replace` into place.")
+
+
+MULTIHOST_DIVERGENT_GUARD = _rule(
+    "TPL801", "multihost-divergence", "process-guard-without-barrier",
+    "jax.process_index()/process_count() (directly or via a variable "
+    "bound from one) guards a branch containing a collective or a "
+    "checkpoint commit, with no barrier (multihost_utils."
+    "sync_global_devices / *barrier*) in the function: if the branch "
+    "wraps a collective, the ranks outside it never arrive — the "
+    "multi-host deadlock; if it wraps a commit, the non-writing ranks "
+    "race past the commit point and can read a checkpoint that is not "
+    "there yet. Add the barrier, or hoist the guarded work out of the "
+    "per-process branch.")
 
 
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -24,12 +24,13 @@ fail loudly (see mp_layers) — this shim never silently no-ops.
 """
 from __future__ import annotations
 
-from typing import Iterable, Optional, Tuple
+from typing import Dict, Iterable, Optional, Tuple
 
 import jax
 
 __all__ = ["shard_map", "axis_size", "ambient_mesh_axis_names",
-           "distributed_is_initialized", "NEW_SHARD_MAP_API"]
+           "distributed_is_initialized", "virtual_mesh",
+           "NEW_SHARD_MAP_API"]
 
 NEW_SHARD_MAP_API = hasattr(jax, "shard_map")
 
@@ -72,6 +73,39 @@ def distributed_is_initialized() -> bool:
     from jax._src import distributed as _distributed
 
     return getattr(_distributed.global_state, "client", None) is not None
+
+
+def virtual_mesh(axes: Dict[str, int]):
+    """A mesh for *tracing* sharded programs at an arbitrary device
+    count — the ``tools/analyze_tpu.py --mesh N`` sweep path.
+
+    When enough local devices exist (the virtual-8-CPU-device harness,
+    a real slice) this returns a concrete ``Mesh`` — everything works:
+    shard_map, NamedSharding constraints, actual execution. When the
+    requested shape exceeds the local device count it falls back to
+    ``AbstractMesh`` (device-free; 0.4.37 already traces shard_map over
+    it), which supports ``jax.make_jaxpr`` analysis but not execution.
+    """
+    import numpy as np
+
+    n = 1
+    for s in axes.values():
+        n *= int(s)
+    devices = jax.devices()
+    if n <= len(devices):
+        from jax.sharding import Mesh
+
+        shape = tuple(int(s) for s in axes.values())
+        return Mesh(np.array(devices[:n]).reshape(shape),
+                    tuple(axes.keys()))
+    from jax.sharding import AbstractMesh
+
+    try:
+        return AbstractMesh(tuple((k, int(v)) for k, v in axes.items()))
+    except TypeError:
+        # newer ctor signature: AbstractMesh(shape_tuple, axis_names)
+        return AbstractMesh(tuple(int(v) for v in axes.values()),
+                            tuple(axes.keys()))
 
 
 def ambient_mesh_axis_names() -> Tuple[str, ...]:

@@ -1,0 +1,37 @@
+"""Regression fixture for the axis_index/TPC202 audit (ISSUE 10
+satellite): ``axis_index`` under a value-dependent ``cond`` is HARMLESS
+per-shard index math — it lowers to a local partition-id read, never
+blocks on peers, and so must NOT trip the multi-host-deadlock rule.
+It stays in COLLECTIVE_PRIMS so TPC201 still checks its axis against
+the mesh (second branch below would fire TPC201 if 'mp' were
+unbound — the axis here is bound, so the report is clean)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    x = jnp.ones((ndev * 4, 8), jnp.float32)
+
+    def f(x):
+        def body(xs):
+            pred = jnp.sum(xs) > 0.0  # per-shard data: hosts may disagree
+
+            def ranked(v):
+                # axis_index under the value-dependent branch: local
+                # compute only — not a deadlock shape
+                i = jax.lax.axis_index("dp")
+                return v + i.astype(v.dtype)
+
+            return jax.lax.cond(pred, ranked, lambda v: v, xs)
+
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None), check=False)(x)
+
+    return analyze_fn(f, x, mesh=mesh)

@@ -1,0 +1,30 @@
+"""Comm seeded shape: an all-reduce of a 4MiB gradient followed by a
+TINY update — almost no compute to hide the transfer under, so the
+communication roofline predicts comm >> compute and the TPC601
+advisory fires with the predicted multichip step time."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    g = jnp.ones((1024, 1024), jnp.float32)  # 4MiB of gradients
+    w = jnp.ones((1024, 1024), jnp.float32)
+
+    def f(w, g):
+        def body(w, g):
+            g = jax.lax.pmean(g, "dp")   # the whole step is this wire
+            return w - 1e-3 * g
+
+        return shard_map(body, mesh, in_specs=(P(), P()),
+                         out_specs=P(), check=False)(w, g)
+
+    return analyze_fn(f, w, g, mesh=mesh,
+                      min_sharding_bytes=16 << 20)  # TPC501 floor above
+    # the 4MiB operands: this fixture isolates the TPC601 advisory

@@ -1,0 +1,31 @@
+"""Comm clean twin: the same all-reduce with REAL compute behind it —
+a large matmul sits between the collective and its first consumer, so
+the transfer hides under the compute window (Megatron-style overlap)
+and the program stays compute-bound: no TPC601."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    g = jnp.ones((256, 256), jnp.float32)   # small gradient wire
+    a = jnp.ones((2048, 2048), jnp.float32)
+    b = jnp.ones((2048, 2048), jnp.float32)
+
+    def f(g, a, b):
+        def body(g, a, b):
+            g = jax.lax.psum(g, "dp")
+            big = a @ b                  # overlap window + compute mass
+            return g + big[:256, :256]
+
+        return shard_map(body, mesh, in_specs=(P(), P(), P()),
+                         out_specs=P(), check=False)(g, a, b)
+
+    return analyze_fn(f, g, a, b, mesh=mesh,
+                      min_sharding_bytes=64 << 20)

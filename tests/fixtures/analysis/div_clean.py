@@ -1,0 +1,26 @@
+"""Divergence clean twin: the same program with the collective hoisted
+out of the host branch — every process identity traces to the
+identical program (reading process_index into a LOGGED host value is
+fine; only letting it steer the trace diverges). No TPC510."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    x = jnp.ones((8 * ndev, 64), jnp.float32)
+
+    def f(x):
+        def body(xs):
+            return jax.lax.psum(xs, "dp")  # every process compiles this
+
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P(), check=False)(x)
+
+    return analyze_fn(f, x, mesh=mesh, check_processes=2)

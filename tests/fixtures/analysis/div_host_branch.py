@@ -1,0 +1,29 @@
+"""Divergence seeded bug: host-side Python branches on
+``jax.process_index()`` while BUILDING the trace — process 0 compiles a
+psum, every other process compiles a passthrough. No single jaxpr is
+wrong; the divergence only exists across traces, which is exactly what
+the retrace-under-simulated-identities detector sees. TPC510."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    x = jnp.ones((8 * ndev, 64), jnp.float32)
+
+    def f(x):
+        def body(xs):
+            if jax.process_index() == 0:       # HOST branch at trace time
+                return jax.lax.psum(xs, "dp")  # only process 0 compiles it
+            return xs
+
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None), check=False)(x)
+
+    return analyze_fn(f, x, mesh=mesh, check_processes=2)

@@ -1,0 +1,31 @@
+"""Sharding seeded bug (the acceptance-criteria shape): a shard_map
+matmul that accidentally all-gathers its 2MiB weight — the full matrix
+materializes on EVERY device before the contraction, so the sharding
+bought nothing and the ICI moved (n-1)/n of the whole weight. TPC503.
+The proper psum-scatter form is the clean twin
+(shard_psum_scatter.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("mp",))
+    W = jnp.ones((512, 1024), jnp.float32)  # 2MiB global
+    x = jnp.ones((8 * ndev, 512), jnp.float32)
+
+    def f(x, W):
+        def body(xs, w_shard):  # w_shard [512/n, 1024]
+            w = jax.lax.all_gather(w_shard, "mp", axis=0, tiled=True)
+            return xs @ w       # full weight on every device
+
+        return shard_map(body, mesh,
+                         in_specs=(P("mp", None), P("mp", None)),
+                         out_specs=P("mp", None))(x, W)
+
+    return analyze_fn(f, x, W, mesh=mesh)

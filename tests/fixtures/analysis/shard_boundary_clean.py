@@ -1,0 +1,31 @@
+"""Sharding clean twin of shard_reshard_boundary: the same two-region
+pipeline with AGREEING specs — the producer's out_spec matches the
+consumer's in_spec, so no resharding copy exists and no TPC5xx
+fires."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    x = jnp.ones((1024, 512), jnp.float32)  # 2MiB
+
+    def f(x):
+        def scale(xs):
+            return xs * 2.0
+
+        def shift(xs):
+            return xs + 1.0
+
+        y = shard_map(scale, mesh, in_specs=P("dp", None),
+                      out_specs=P("dp", None))(x)
+        return shard_map(shift, mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(y)
+
+    return analyze_fn(f, x, mesh=mesh)

@@ -1,0 +1,27 @@
+"""Sharding seeded bug: a psum over a mesh axis of size 1 while the
+mesh's OTHER axis carries all the devices — the collective lowers to a
+no-op copy. The code was factored for a (dp, mp) mesh with real mp
+parallelism; on this mesh shape it silently reduces nothing. TPC503
+(degenerate arm)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev, 1), ("dp", "mp"))
+    x = jnp.ones((8 * ndev, 64), jnp.float32)
+
+    def f(x):
+        def body(xs):
+            return jax.lax.psum(xs, "mp")  # mp has size 1: a no-op
+
+        return shard_map(body, mesh, in_specs=P("dp", None),
+                         out_specs=P("dp", None))(x)
+
+    return analyze_fn(f, x, mesh=mesh)

@@ -1,0 +1,27 @@
+"""Sharding seeded bug: a 2MiB weight enters a shard_map region with an
+empty in_spec — every device of the 8-way mesh holds the FULL array
+(shard_map replicates whatever the spec does not shard, silently).
+TPC501."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("mp",))
+    W = jnp.ones((512, 1024), jnp.float32)  # 2MiB — parameter-sized
+    x = jnp.ones((8 * ndev, 512), jnp.float32)
+
+    def f(x, W):
+        def body(xs, w):  # w arrives FULL on every device
+            return xs @ w
+
+        return shard_map(body, mesh, in_specs=(P("mp", None), P()),
+                         out_specs=P("mp", None))(x, W)
+
+    return analyze_fn(f, x, W, mesh=mesh)

@@ -1,0 +1,32 @@
+"""Sharding seeded bug: a 2MiB activation produced by one shard_map
+region under P('dp', None) is consumed by the next region under
+P(None, 'dp') — XLA inserts a full resharding copy (gather + reslice
+over ICI) at the jit boundary, invisible in the source. TPC502."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.analysis.jaxpr import analyze_fn
+from paddle_tpu.distributed.jax_compat import shard_map
+
+
+def run():
+    ndev = len(jax.devices())
+    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    x = jnp.ones((1024, 512), jnp.float32)  # 2MiB
+
+    def f(x):
+        def scale(xs):
+            return xs * 2.0
+
+        def shift(xs):
+            return xs + 1.0
+
+        y = shard_map(scale, mesh, in_specs=P("dp", None),
+                      out_specs=P("dp", None))(x)
+        # consumed under a DIFFERENT spec: resharding copy lands here
+        return shard_map(shift, mesh, in_specs=P(None, "dp"),
+                         out_specs=P(None, "dp"))(y)
+
+    return analyze_fn(f, x, mesh=mesh)

@@ -1,0 +1,67 @@
+"""TPL801 fixtures — host-side branches on the process identity around
+work every process must agree on. A collective inside the branch is the
+multi-host deadlock (the ranks outside never arrive); a checkpoint
+commit inside it races the non-writing ranks past the commit point.
+Compliant code either re-converges through a documented barrier
+(multihost_utils.sync_global_devices / *barrier*) or hoists the guarded
+work out of the branch."""
+import jax
+import os
+
+from some_dist_lib import dist, manager, multihost_utils  # fixture stub
+
+
+def bad_rank0_collective(t):
+    if jax.process_index() == 0:  # EXPECT: TPL801
+        dist.all_reduce(t)
+    return t
+
+
+def bad_rank_var_commit(state, ckpt_path):
+    rank = jax.process_index()
+    if rank == 0:  # EXPECT: TPL801
+        manager.save(ckpt_path, state)
+
+
+def bad_else_branch_gather(t):
+    if jax.process_index() != 0:  # EXPECT: TPL801
+        pass
+    else:
+        dist.all_gather(t)
+
+
+def bad_count_guarded_manifest(root):
+    if jax.process_count() > 1:  # EXPECT: TPL801
+        manager.write_manifest(root)
+
+
+def good_barrier_after_commit(state, ckpt_path):
+    if jax.process_index() == 0:
+        manager.save(ckpt_path, state)
+    # every rank re-converges before anyone reads the commit point
+    multihost_utils.sync_global_devices("ckpt-commit")
+
+
+def good_rank0_logging_only(metrics):
+    # branching on the identity is fine when the guarded work is
+    # host-local (no collective, no commit)
+    if jax.process_index() == 0:
+        print("step metrics:", metrics)
+
+
+def good_every_rank_commits(state, ckpt_path):
+    # no branch: all ranks participate in the commit protocol
+    manager.save(ckpt_path, state)
+
+
+def good_ternary_threshold(root):
+    # reading the identity into a VALUE is not a divergent guard
+    min_age = 0.0 if jax.process_count() == 1 else 3600.0
+    return min_age
+
+
+def suppressed_rank0_broadcast(t):
+    # tpulint: disable=TPL801 -- fixture: peers block in a matching
+    # recv posted outside this module, documented at the call site
+    if jax.process_index() == 0:  # EXPECT-SUPPRESSED: TPL801
+        dist.broadcast(t, src=0)

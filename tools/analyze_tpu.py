@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -32,12 +33,54 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
+def _force_virtual_devices(n: int = 8) -> None:
+    """Raise the virtual-CPU-device count to >= n BEFORE jax initializes
+    (same trick as tests/conftest.py): the distributed entries trace
+    real meshes, and the ``--mesh {1,4,8}`` sweep needs 8 devices even
+    from a bare ``make analyze`` shell. A no-op when the flag is already
+    high enough (pytest) or when jax was initialized first (the mesh
+    helpers then fall back to AbstractMesh)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        if int(m.group(1)) < n:
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={n}")
+    else:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+_force_virtual_devices()
+
+# the mesh size the distributed entries build against; None = all local
+# devices (the --mesh sweep rebinds this per pass)
+_MESH_N: Optional[int] = None
+
+
+def _mesh_n() -> int:
+    import jax
+
+    return _MESH_N if _MESH_N is not None else min(8, len(jax.devices()))
+
+
+def _dist_mesh(**axes: int):
+    """Mesh for a distributed entry: concrete over the virtual CPU
+    devices when they suffice, AbstractMesh beyond (trace-only)."""
+    from paddle_tpu.distributed.jax_compat import virtual_mesh
+
+    return virtual_mesh(dict(axes))
+
+
 @dataclass
 class Entry:
     name: str
     build: Callable  # () -> (fn, args:list, kwargs for analyze_fn)
     note: str = ""
     suppress: Dict[str, str] = field(default_factory=dict)
+    # meshable entries re-run under every --mesh size (their build reads
+    # _mesh_n()); the rest trace once per sweep
+    meshable: bool = False
 
 
 # --------------------------------------------------------------- entries
@@ -198,13 +241,12 @@ def _dp_psum_step():
     averaging over the 'dp' axis of the active mesh."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from paddle_tpu.distributed.jax_compat import shard_map
 
-    ndev = max(len(jax.devices()), 1)
-    mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+    ndev = _mesh_n()
+    mesh = _dist_mesh(dp=ndev)
     W = jnp.ones((128, 128), jnp.float32)
     x = jnp.ones((8 * ndev, 128), jnp.float32)
 
@@ -318,6 +360,239 @@ def _chunked_prefill_step():
     return fn, args, {"donate_argnums": (1,)}
 
 
+def _tp_train_step():
+    """Megatron tensor-parallel train step over the 'mp' axis (ISSUE 10
+    tentpole): the Column+Row pair from test_tensor_parallel's model,
+    written as the manual shard_map twin of the layers' GSPMD specs —
+    forward psum after the row matmul (the Megatron g collective),
+    backward psum on the replicated input's grad (the f collective),
+    local SGD update on the sharded weights."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.fleet.meta_parallel import (
+        ColumnParallelLinear, RowParallelLinear)
+    from paddle_tpu.distributed.jax_compat import shard_map
+
+    paddle.seed(0)
+    mp = _mesh_n()
+    mesh = _dist_mesh(mp=mp)
+    H, FF, B = 16, 64, 8
+    col = ColumnParallelLinear(H, FF, gather_output=False)
+    row = RowParallelLinear(FF, H, input_is_parallel=True)
+    w1, b1 = col.weight._data, col.bias._data
+    w2, b2 = row.weight._data, row.bias._data
+    x = jnp.ones((B, H), jnp.float32)
+
+    def tp_train_step(x, w1, b1, w2, b2):
+        def body(x, w1, b1, w2, b2):
+            def loss_fn(w1, b1, w2, b2):
+                h = jax.nn.gelu(x @ w1 + b1)        # [B, FF/mp] local
+                y = jax.lax.psum(h @ w2, "mp") + b2  # the g collective
+                return jnp.mean(y * y)
+
+            loss, grads = jax.value_and_grad(loss_fn,
+                                             argnums=(0, 1, 2, 3))(
+                w1, b1, w2, b2)
+            g1, gb1, g2, gb2 = grads
+            # replicated bias grad reduces over mp (the f conjugate);
+            # sharded weight grads are already local
+            gb2 = jax.lax.psum(gb2, "mp")
+            lr = 1e-2
+            return (w1 - lr * g1, b1 - lr * gb1, w2 - lr * g2,
+                    b2 - lr * gb2, jax.lax.pmean(loss, "mp"))
+
+        # in_specs mirror the layers' dist_specs: column weight
+        # P(None,'mp'), its bias P('mp'), row weight P('mp',None),
+        # row bias replicated (post-reduction)
+        return shard_map(
+            body, mesh,
+            in_specs=(P(), P(None, "mp"), P("mp"), P("mp", None), P()),
+            out_specs=(P(None, "mp"), P("mp"), P("mp", None), P(), P()),
+            check=False)(x, w1, b1, w2, b2)
+
+    return tp_train_step, [x, w1, b1, w2, b2], {
+        "mesh": mesh, "check_processes": 2}
+
+
+def _pipeline_1f1b_stage():
+    """One 1F1B pipeline stage over the 'pp' axis: scan over microbatch
+    ticks, each tick applying the stage-local layer and ppermuting the
+    activation to the next stage — the stage-boundary transfer
+    pipeline_engine's shard_map pipe drives (comm that should overlap
+    with the next tick's compute)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.jax_compat import shard_map
+
+    pp = _mesh_n()
+    mesh = _dist_mesh(pp=pp)
+    H, B, M = 32, 4, 4  # hidden, microbatch rows, microbatches
+    W = jnp.ones((pp, H, H), jnp.float32) * 0.01  # stage-stacked weights
+    x = jnp.ones((B, H), jnp.float32)
+    perm = [(i, i + 1) for i in range(pp - 1)]  # fwd stage ring, no wrap
+
+    def pipeline_1f1b_stage(x, W):
+        def body(x, w):
+            w = w[0]  # this stage's layer
+
+            def tick(h, _):
+                out = jax.nn.gelu(h @ w)
+                recv = jax.lax.ppermute(out, "pp", perm) if perm else out
+                return recv, out
+
+            h, outs = jax.lax.scan(tick, x, None, length=M)
+            return h, outs
+
+        return shard_map(body, mesh,
+                         in_specs=(P(), P("pp", None, None)),
+                         out_specs=(P(), P()), check=False)(x, W)
+
+    return pipeline_1f1b_stage, [x, W], {"mesh": mesh,
+                                         "check_processes": 2}
+
+
+def _context_parallel_attention():
+    """Ring attention (context parallelism) over the 'sep' axis: the
+    REAL distributed/fleet/meta_parallel/context_parallel.py kernel —
+    per-chunk flash attention with (out, lse) log-space merges riding
+    ppermute inside a scan."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.distributed.fleet.meta_parallel.context_parallel import (
+        ring_attention)
+
+    sep = _mesh_n()
+    mesh = _dist_mesh(sep=sep)
+    rng = np.random.default_rng(0)
+    B, S, H, D = 2, 8 * max(sep, 1), 2, 16
+    q, k, v = (jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+               for _ in range(3))
+
+    def context_parallel_attention(q, k, v):
+        return ring_attention(q, k, v, mesh=mesh, causal=True)
+
+    return context_parallel_attention, [q, k, v], {
+        "mesh": mesh, "check_processes": 2}
+
+
+def _moe_all_to_all():
+    """Expert-parallel MoE dispatch (ISSUE 10 / ROADMAP item 5): the
+    reference global_scatter/global_gather shape written as explicit
+    all_to_alls over the 'ep' axis — gshard_dispatch (incubate/nn's real
+    routing) builds the [T,E,C] one-hots, tokens exchange to their
+    expert's device, the local ExpertFFN runs, and the combine a2a
+    returns them. Grads flow through both all_to_alls (their transpose
+    IS the reverse exchange)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.jax_compat import shard_map
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import (
+        ExpertFFN, gshard_dispatch)
+
+    paddle.seed(0)
+    ep = _mesh_n()
+    mesh = _dist_mesh(ep=ep)
+    E = ep                      # one expert per device
+    H, FF, T, C = 16, 32, 8 * ep, 8  # tokens global, capacity per expert
+    experts = [ExpertFFN(H, FF, activation="gelu") for _ in range(E)]
+    w1 = jnp.stack([e.fc1.weight._data for e in experts])
+    bb1 = jnp.stack([e.fc1.bias._data for e in experts])
+    w2 = jnp.stack([e.fc2.weight._data for e in experts])
+    bb2 = jnp.stack([e.fc2.bias._data for e in experts])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((T, H)), jnp.float32)
+    gate_logits = jnp.asarray(rng.standard_normal((T, E)), jnp.float32)
+
+    def moe_all_to_all(x, gate_logits, w1, b1, w2, b2):
+        def body(x, gate_logits, w1, b1, w2, b2):
+            # top-1 routing over the LOCAL token shard
+            val = jax.nn.softmax(gate_logits, axis=-1)
+            idx = jnp.argmax(gate_logits, axis=-1)
+            top = jnp.take_along_axis(val, idx[:, None], axis=-1)
+            dispatch, combine = gshard_dispatch(top, idx[:, None], E, C)
+            ein = jnp.einsum("tec,th->ech", dispatch, x)   # [E, C, H]
+            # the global_scatter: slot e of every device -> device e
+            recv = jax.lax.all_to_all(ein, "ep", split_axis=0,
+                                      concat_axis=0)        # [E, C, H]
+            toks = recv.reshape(E * C, -1)
+            hmid = jax.nn.gelu(toks @ w1[0] + b1[0])
+            out = (hmid @ w2[0] + b2[0]).reshape(E, C, -1)
+            # the global_gather: results return to their source device
+            back = jax.lax.all_to_all(out, "ep", split_axis=0,
+                                      concat_axis=0)
+            y = jnp.einsum("tec,ech->th", combine, back)
+            return jax.lax.pmean(jnp.mean(y * y), "ep")
+
+        def loss_fn(w1, b1, w2, b2):
+            return shard_map(
+                body, mesh,
+                in_specs=(P("ep", None), P("ep", None),
+                          P("ep", None, None), P("ep", None),
+                          P("ep", None, None), P("ep", None)),
+                out_specs=P(), check=False)(
+                x, gate_logits, w1, b1, w2, b2)
+
+        loss, grads = jax.value_and_grad(loss_fn, argnums=(0, 1, 2, 3))(
+            w1, b1, w2, b2)
+        return loss, grads
+
+    return moe_all_to_all, [x, gate_logits, w1, bb1, w2, bb2], {
+        "mesh": mesh, "check_processes": 2}
+
+
+def _moe_ep_gspmd():
+    """The incubate/nn MoELayer's OWN expert-parallel path (GSPMD): the
+    [E,C,H] dispatch einsum with a with_sharding_constraint over the
+    mesh axis — the sharding pass sees the constraint boundary, the
+    comm pass prices the XLA-inserted exchange (assumed_reshard)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.parallel import set_mesh
+    from paddle_tpu.framework.tensor import Tensor, pause_tape
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+    from paddle_tpu.incubate.distributed.models.moe.gate import NaiveGate
+    from paddle_tpu.incubate.distributed.models.moe.moe_layer import ExpertFFN
+    from paddle_tpu.jit import swapped_params
+
+    paddle.seed(0)
+    ep = _mesh_n()
+    mesh = _dist_mesh(ep=ep)
+    H, E = 16, 8  # 8 experts: divisible at every swept mesh size (1/4/8)
+    layer = MoELayer(
+        d_model=H, experts=[ExpertFFN(H, 2 * H) for _ in range(E)],
+        gate=NaiveGate(H, E, topk=2), capacity_factor=4.0,
+        axis_name="ep", use_ragged=False)
+    layer.eval()
+    params = [p._data for _, p in layer.named_parameters()]
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 16, H)), jnp.float32)
+
+    def moe_ep_gspmd(params, x):
+        set_mesh(mesh)  # host-side: the layer reads the active mesh
+        try:
+            with swapped_params(layer, params), pause_tape():
+                out = layer(Tensor._wrap(x))
+            o = out._data if isinstance(out, Tensor) else out
+            return jnp.mean(o.astype(jnp.float32) ** 2)
+        finally:
+            set_mesh(None)
+
+    return moe_ep_gspmd, [params, x], {"mesh": mesh, "check_processes": 2}
+
+
 ENTRIES: List[Entry] = [
     Entry("llama_decode_step", _llama_decode_step,
           "serving decode: one token through the slab KV cache"),
@@ -331,7 +606,23 @@ ENTRIES: List[Entry] = [
     Entry("quant_matmul_int4", lambda: _quant_matmul("int4"),
           "weight-only packed-int4 GEMM"),
     Entry("dp_psum_step", _dp_psum_step,
-          "shard_map data-parallel step (collective pass coverage)"),
+          "shard_map data-parallel step (collective pass coverage)",
+          meshable=True),
+    Entry("tp_train_step", _tp_train_step,
+          "Megatron TP train step: Column+Row pair, fwd/bwd psum, SGD",
+          meshable=True),
+    Entry("pipeline_1f1b_stage", _pipeline_1f1b_stage,
+          "1F1B stage: microbatch scan + ppermute stage boundary",
+          meshable=True),
+    Entry("context_parallel_attention", _context_parallel_attention,
+          "ring attention over 'sep' (real context_parallel kernel)",
+          meshable=True),
+    Entry("moe_all_to_all", _moe_all_to_all,
+          "expert-parallel MoE: gshard dispatch + explicit all_to_alls",
+          meshable=True),
+    Entry("moe_ep_gspmd", _moe_ep_gspmd,
+          "MoELayer GSPMD EP path: sharding-constraint boundary",
+          meshable=True),
     Entry("spec_verify_step", _spec_verify_step,
           "spec-decode verify: k+1 positions + acceptance, paged path"),
     Entry("verify_slab_attention", _verify_slab_attention,
@@ -344,11 +635,23 @@ ENTRIES: List[Entry] = [
 # --------------------------------------------------------------- running
 
 
-def run_entry(entry: Entry, budget_bytes: Optional[int] = None):
+def run_entry(entry: Entry, budget_bytes: Optional[int] = None,
+              mesh_n: Optional[int] = None,
+              label: Optional[str] = None):
+    """Analyze one registry entry, optionally under an explicit mesh
+    size (rebinds the module-global the meshable builders read)."""
+    global _MESH_N
+
     from paddle_tpu.analysis.jaxpr import analyze_fn
 
-    fn, args, kw = entry.build()
-    kw.setdefault("entry", entry.name)
+    saved = _MESH_N
+    if mesh_n is not None:
+        _MESH_N = mesh_n
+    try:
+        fn, args, kw = entry.build()
+    finally:
+        _MESH_N = saved
+    kw["entry"] = label or entry.name
     if budget_bytes is not None:
         kw.setdefault("budget_bytes", budget_bytes)
     return analyze_fn(fn, *args, **kw)
@@ -366,6 +669,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--list-entries", action="store_true")
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--json", action="store_true",
+                    help="alias for --format json (sorted, diffable)")
+    ap.add_argument("--mesh", action="append", type=int, default=None,
+                    metavar="N",
+                    help="mesh size to trace the distributed entries "
+                         "under (repeatable: --mesh 1 --mesh 4 --mesh 8 "
+                         "sweeps; uses virtual devices / AbstractMesh, "
+                         "no real slice needed). Non-mesh entries trace "
+                         "once per sweep.")
     ap.add_argument("--fail-on-violation", action="store_true",
                     help="exit 1 on any unsuppressed error/warn finding")
     ap.add_argument("--show-info", action="store_true",
@@ -373,6 +685,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--budget-gb", type=float, default=None,
                     help="HBM budget for TPC101, in GiB")
     args = ap.parse_args(argv)
+    if args.json:
+        args.format = "json"
 
     if args.list_rules:
         from paddle_tpu.analysis.jaxpr.rules import JRULES
@@ -403,24 +717,36 @@ def main(argv: Optional[List[str]] = None) -> int:
     budget = (int(args.budget_gb * (1 << 30))
               if args.budget_gb is not None else None)
 
+    mesh_sizes: List[Optional[int]] = list(args.mesh) if args.mesh \
+        else [None]
+
     gating = []        # unsuppressed error/warn
     suppressed = []    # (finding, reason)
     infos = []
-    reports = {}
-    for e in chosen:
-        report = run_entry(e, budget)
-        reports[e.name] = report
-        for f in report.findings:
-            if f.severity == "info":
-                infos.append(f)
-            elif f.rule in e.suppress and e.suppress[f.rule].strip():
-                suppressed.append((f, e.suppress[f.rule]))
-            else:
-                gating.append(f)
+    reports = {}       # label -> report
+    n_runs = 0
+    for i, mn in enumerate(mesh_sizes):
+        for e in chosen:
+            if i > 0 and not e.meshable:
+                continue  # non-mesh entries are mesh-invariant
+            label = e.name
+            if mn is not None and e.meshable and len(mesh_sizes) > 1:
+                label = f"{e.name}@m{mn}"
+            report = run_entry(e, budget, mesh_n=mn, label=label)
+            reports[label] = report
+            n_runs += 1
+            for f in report.findings:
+                if f.severity == "info":
+                    infos.append(f)
+                elif f.rule in e.suppress and e.suppress[f.rule].strip():
+                    suppressed.append((f, e.suppress[f.rule]))
+                else:
+                    gating.append(f)
 
     if args.format == "json":
         payload = {
-            "entries": [e.name for e in chosen],
+            "entries": sorted(reports),
+            "mesh_sizes": [m for m in mesh_sizes if m is not None],
             "findings": [vars(f.to_violation()) | {
                 "severity": f.severity, "pass": f.passname, "data": f.data}
                 for f in gating],
@@ -430,13 +756,28 @@ def main(argv: Optional[List[str]] = None) -> int:
             "memory": {
                 n: {"peak_bytes": r.memory.peak_bytes,
                     "peak_temp_out_bytes": r.memory.peak_temp_out_bytes}
-                for n, r in reports.items() if r.memory is not None},
+                for n, r in sorted(reports.items())
+                if r.memory is not None},
             "cost": {
                 n: {"flops": r.cost.flops, "hbm_bytes": r.cost.hbm_bytes,
                     "predicted_ms": r.cost.predicted_seconds() * 1e3}
-                for n, r in reports.items() if r.cost is not None},
+                for n, r in sorted(reports.items())
+                if r.cost is not None},
+            "comm": {
+                n: {"wire_bytes": r.comm.wire_bytes,
+                    "comm_ms": r.comm.comm_seconds * 1e3,
+                    "overlap_fraction": round(r.comm.overlap_fraction, 4),
+                    "n_collectives": r.comm.n_collectives,
+                    "predicted_step_ms": (
+                        (r.cost.predicted_seconds() if r.cost else 0.0)
+                        + r.comm.comm_seconds
+                        - min(r.comm.overlapped_seconds,
+                              r.cost.predicted_seconds()
+                              if r.cost else 0.0)) * 1e3}
+                for n, r in sorted(reports.items())
+                if r.comm is not None and r.comm.n_collectives > 0},
         }
-        print(json.dumps(payload, indent=2))
+        print(json.dumps(payload, indent=2, sort_keys=True))
     else:
         for f in gating:
             print(f.to_violation().format())
@@ -447,9 +788,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         if args.show_info:
             for f in infos:
                 print(f.to_violation().format())
-        print(f"tpucheck: {len(chosen)} entries, {len(gating)} finding"
-              f"{'s' if len(gating) != 1 else ''}, {len(suppressed)} "
-              f"suppressed, {len(infos)} advisory")
+        mesh_note = ""
+        if args.mesh:
+            mesh_note = f" (mesh sweep {sorted(set(args.mesh))})"
+        print(f"tpucheck: {n_runs} entry runs{mesh_note}, {len(gating)} "
+              f"finding{'s' if len(gating) != 1 else ''}, "
+              f"{len(suppressed)} suppressed, {len(infos)} advisory")
 
     if args.fail_on_violation and gating:
         return 1

@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""Multichip harness (ISSUE 10 satellite): structured per-suite timings
+and a measured-vs-predicted communication roofline for the TP step.
+
+``MULTICHIP_r*.json`` used to record only ``{n_devices, rc, ok, tail}``
+— a green light with no numbers, so the tpushard comm pass (TPC601) had
+no measured counterpart to track drift against. This harness emits:
+
+* **suites** — wall time of each strategy-surface dryrun
+  (``__graft_entry__``'s hybrid pipeline, sep ring attention, MoE EP,
+  auto-parallel Engine, stage-3 sharding);
+* **tp_step** — the tensor-parallel train step measured three ways:
+  the full step, a collective-stripped local twin (their difference is
+  the MEASURED comm fraction), and the tpushard-predicted step time
+  under a host-calibrated device profile (matmul flops, memcpy
+  bandwidth, and per-collective-step latency are measured on THIS
+  host, then fed through the same cost formulas the TPC601 advisory
+  uses) — with the predicted/measured ratio bench.py's metrics block
+  records.
+
+Runs on the virtual-8-CPU-device mesh (no TPU slice needed); on a real
+slice the same code measures real ICI. ``--json`` prints one
+machine-readable object; the driver-visible ``dryrun_multichip`` prints
+the same object on its ``MULTICHIP_METRICS`` tail line.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _force_virtual_devices(n: int = 8) -> None:
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m:
+        if int(m.group(1)) < n:
+            os.environ["XLA_FLAGS"] = flags.replace(
+                m.group(0), f"--xla_force_host_platform_device_count={n}")
+    else:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+
+
+_force_virtual_devices()
+
+
+# ------------------------------------------------------------ calibration
+
+
+def calibrate_host() -> Dict[str, float]:
+    """Measured peaks of THIS host, the device profile the prediction
+    prices against: dense matmul flops/s, memcpy bytes/s, and the
+    per-collective-step latency of a tiny psum on the live mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.jax_compat import shard_map
+
+    # flops: a 512^3 matmul, best of 3
+    a = jnp.ones((512, 512), jnp.float32)
+    mm = jax.jit(lambda a: a @ a)
+    mm(a).block_until_ready()
+    best = min(_timed(lambda: mm(a).block_until_ready(), 3))
+    flops = 2.0 * 512 ** 3 / best
+
+    # memory bandwidth: copy 32MiB, read+write
+    big = jnp.ones((8 << 20,), jnp.float32)  # 32MiB
+    cp = jax.jit(lambda x: x + 1.0)
+    cp(big).block_until_ready()
+    best = min(_timed(lambda: cp(big).block_until_ready(), 3))
+    membw = 2.0 * big.nbytes / best
+
+    # collective step latency: a scalar-ish psum on the mesh; its wire
+    # time is ~0, so step time / ring steps is the per-step latency
+    ndev = len(jax.devices())
+    lat = 20e-6
+    if ndev > 1:
+        mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
+        tiny = jnp.ones((8,), jnp.float32)
+        ps = jax.jit(shard_map(lambda x: jax.lax.psum(x, "dp"), mesh,
+                               in_specs=P(), out_specs=P(), check=False))
+        ps(tiny).block_until_ready()
+        best = min(_timed(lambda: ps(tiny).block_until_ready(), 5))
+        lat = best / (2 * (ndev - 1))
+    return {"flops_per_s": flops, "mem_bytes_per_s": membw,
+            "coll_step_latency_s": lat}
+
+
+def _timed(fn, n: int):
+    out = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        fn()
+        out.append(time.perf_counter() - t0)
+    return out
+
+
+# ------------------------------------------------------------ TP step
+
+
+def _tp_programs(n: int):
+    """(full_step, local_twin, args): the Megatron Column+Row pair from
+    the tp_train_step analyze entry at bench shapes; the twin strips
+    the collectives (same per-shard compute, no wire) so full - twin
+    isolates the measured comm cost."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from paddle_tpu.distributed.jax_compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("mp",))
+    H, FF, B = 256, 1024, 64
+    rng = np.random.default_rng(0)
+    w1 = jnp.asarray(rng.standard_normal((H, FF)) * 0.02, jnp.float32)
+    b1 = jnp.zeros((FF,), jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((FF, H)) * 0.02, jnp.float32)
+    b2 = jnp.zeros((H,), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((B, H)), jnp.float32)
+    args = (x, w1, b1, w2, b2)
+
+    def make(with_collectives: bool):
+        def body(x, w1, b1, w2, b2):
+            def loss_fn(w1, b1, w2, b2):
+                h = jax.nn.gelu(x @ w1 + b1)
+                y = h @ w2
+                if with_collectives:
+                    y = jax.lax.psum(y, "mp")
+                y = y + b2
+                return jnp.mean(y * y)
+
+            loss, grads = jax.value_and_grad(
+                loss_fn, argnums=(0, 1, 2, 3))(w1, b1, w2, b2)
+            g1, gb1, g2, gb2 = grads
+            if with_collectives:
+                gb2 = jax.lax.psum(gb2, "mp")
+                loss = jax.lax.pmean(loss, "mp")
+            lr = 1e-2
+            return (w1 - lr * g1, b1 - lr * gb1, w2 - lr * g2,
+                    b2 - lr * gb2, loss)
+
+        return shard_map(
+            body, mesh,
+            in_specs=(P(), P(None, "mp"), P("mp"), P("mp", None), P()),
+            out_specs=(P(None, "mp"), P("mp"), P("mp", None), P(), P()),
+            check=False)
+
+    return make(True), make(False), args, mesh
+
+
+def tp_step_metrics(n_devices: int, steps: int = 16) -> Dict[str, object]:
+    import jax
+
+    full, twin, args, mesh = _tp_programs(n_devices)
+    jfull, jtwin = jax.jit(full), jax.jit(twin)
+
+    def run(fn):
+        out = fn(*args)
+        jax.block_until_ready(out)
+        # median, not min: on the CPU-host run the twin/full difference
+        # sits inside scheduler noise and min() flips their order
+        ts = sorted(_timed(lambda: jax.block_until_ready(fn(*args)),
+                           steps))
+        return ts[len(ts) // 2]
+
+    t_full = run(jfull)
+    t_twin = run(jtwin)
+    comm_frac_measured = max(0.0, 1.0 - t_twin / t_full)
+
+    # predicted under the host-calibrated profile, through the SAME
+    # rollups the TPC601 advisory uses
+    from paddle_tpu.analysis.jaxpr import comm_rollup, rollup
+
+    cal = calibrate_host()
+    closed = jax.make_jaxpr(full)(*args)
+    cr = rollup(closed)
+    est = comm_rollup(closed, mesh=mesh)
+    compute_s = sum(max(f / cal["flops_per_s"],
+                        b / cal["mem_bytes_per_s"])
+                    for f, b in cr.by_prim.values())
+    comm_s = est.seconds_at(cal["mem_bytes_per_s"],
+                            cal["coll_step_latency_s"])
+    overlapped = min(comm_s * est.overlap_fraction, compute_s)
+    pred_s = compute_s + comm_s - overlapped
+    # the drift-tracking prediction swaps the modeled compute term for
+    # the MEASURED collective-stripped twin: the comm model is what
+    # TPC601 asserts (the compute roofline is validated separately in
+    # tests/test_jaxpr_analysis.py), and on a CPU host the virtual
+    # devices share cores in ways the per-device compute model cannot
+    # see — isolating the comm term keeps the ratio meaningful there
+    hybrid_s = t_twin + comm_s - min(comm_s * est.overlap_fraction,
+                                     t_twin)
+    return {
+        "n_devices": n_devices,
+        "measured_step_ms": round(t_full * 1e3, 4),
+        "measured_local_twin_ms": round(t_twin * 1e3, 4),
+        "comm_fraction_measured": round(comm_frac_measured, 4),
+        "predicted_step_ms": round(hybrid_s * 1e3, 4),
+        "predicted_step_model_ms": round(pred_s * 1e3, 4),
+        "predicted_comm_ms": round(comm_s * 1e3, 4),
+        "comm_fraction_predicted": round(
+            comm_s / pred_s if pred_s else 0.0, 4),
+        "overlap_fraction_predicted": round(est.overlap_fraction, 4),
+        "pred_vs_measured": round(
+            hybrid_s / t_full if t_full else 0.0, 4),
+        "pred_vs_measured_model": round(
+            pred_s / t_full if t_full else 0.0, 4),
+        "calibration": {k: float(f"{v:.6g}") for k, v in cal.items()},
+        "host": "cpu" if jax.default_backend() == "cpu" else
+                jax.devices()[0].device_kind,
+    }
+
+
+# ------------------------------------------------------------ suites
+
+
+def suite_timings(n_devices: int) -> Dict[str, Dict[str, object]]:
+    """Each claimed strategy surface, one tiny executed step, timed."""
+    import __graft_entry__ as g
+
+    suites = {
+        "hybrid_pipeline": g._dryrun_hybrid_pipeline,
+        "sep_ring_attention": g._dryrun_sep_ring_attention,
+        "moe_ep": g._dryrun_moe_ep,
+        "autoparallel_engine": g._dryrun_autoparallel_engine,
+        "sharding_stage3": g._dryrun_sharding_stage3,
+    }
+    out: Dict[str, Dict[str, object]] = {}
+    for name, fn in suites.items():
+        t0 = time.perf_counter()
+        try:
+            fn(n_devices)
+            out[name] = {"ok": True,
+                         "seconds": round(time.perf_counter() - t0, 3)}
+        except Exception as e:
+            out[name] = {"ok": False,
+                         "seconds": round(time.perf_counter() - t0, 3),
+                         "error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def multichip_metrics(n_devices: int, tp_only: bool = False
+                      ) -> Dict[str, object]:
+    payload: Dict[str, object] = {
+        "schema": "paddle_tpu.multichip.v2",
+        "n_devices": n_devices,
+        "tp_step": tp_step_metrics(n_devices),
+    }
+    if not tp_only:
+        payload["suites"] = suite_timings(n_devices)
+        payload["ok"] = all(s.get("ok") for s in payload["suites"].values())
+    return payload
+
+
+def main(argv: Optional[list] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="multichip",
+        description="structured multichip harness: suite timings + "
+                    "measured-vs-predicted TP comm roofline")
+    ap.add_argument("--n-devices", type=int, default=8)
+    ap.add_argument("--tp-only", action="store_true",
+                    help="skip the strategy-surface suites (bench.py's "
+                         "fast path)")
+    ap.add_argument("--json", action="store_true",
+                    help="print one machine-readable JSON object")
+    ap.add_argument("--out", default=None,
+                    help="also write the payload to this file")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    if len(jax.devices()) < args.n_devices:
+        print(json.dumps({"ok": False,
+                          "error": f"only {len(jax.devices())} devices "
+                                   f"(need {args.n_devices}); run from a "
+                                   f"fresh shell so the virtual-device "
+                                   f"flag takes effect"}))
+        return 1
+
+    payload = multichip_metrics(args.n_devices, tp_only=args.tp_only)
+    text = json.dumps(payload, indent=None if args.json else 2,
+                      sort_keys=True)
+    print(text)
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(json.dumps(payload, indent=2, sort_keys=True) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,7 +28,7 @@ analyze:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
 		tests/test_train_resilience.py tests/test_prefix_cache.py \
-		tests/test_chunked_prefill.py -q
+		tests/test_chunked_prefill.py tests/test_tp_serving.py -q
 
 test: lint analyze chaos
 	python -m pytest tests/ -x -q --ignore=tests/onchip

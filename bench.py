@@ -520,7 +520,7 @@ def bench_multichip():
             capture_output=True, text=True, timeout=600, env=env)
         payload = json.loads(proc.stdout.strip().splitlines()[-1])
         tp = payload["tp_step"]
-        return {
+        out = {
             "multichip_tp_step_ms": tp["measured_step_ms"],
             "multichip_tp_pred_ms": tp["predicted_step_ms"],
             "multichip_comm_fraction_measured":
@@ -528,7 +528,28 @@ def bench_multichip():
             "multichip_comm_fraction_pred":
                 tp["comm_fraction_predicted"],
             "multichip_pred_vs_measured": tp["pred_vs_measured"],
+            # calibration satellite (ISSUE 11): intercept/slope split of
+            # the tiny-psum fit; target ≤1.15x on the TP train step
+            "multichip_tp_calibrated_ok": bool(
+                tp["pred_vs_measured"] <= 1.15),
         }
+        ts = payload.get("tp_serving")
+        if ts is not None:
+            # sharded serving programs (ISSUE 11): TP decode chain +
+            # mixed chunk step vs their collective-stripped twins,
+            # gated by the same 2x ratio band as the TP train step
+            r = ts["pred_vs_measured"]
+            out.update({
+                "multichip_tp_serving_decode_ms": ts["decode_step_ms"],
+                "multichip_tp_serving_mixed_ms": ts["mixed_step_ms"],
+                "multichip_tp_serving_comm_fraction_measured":
+                    ts["comm_fraction_measured"],
+                "multichip_tp_serving_comm_fraction_pred":
+                    ts["comm_fraction_predicted"],
+                "multichip_tp_serving_pred_vs_measured": r,
+                "multichip_tp_serving_ok": bool(0.5 <= r <= 2.0),
+            })
+        return out
     except Exception as e:
         return {"multichip_error": f"{type(e).__name__}: {e}"}
 
@@ -658,6 +679,10 @@ def main():
         # TP step vs the measured one (tools/multichip.py subprocess)
         "multichip_pred_vs_measured": multichip.get(
             "multichip_pred_vs_measured", 0.0),
+        # tensor-parallel serving drift (ISSUE 11): the sharded decode
+        # chain + mixed chunk step vs their collective-stripped twins
+        "multichip_tp_serving_pred_vs_measured": multichip.get(
+            "multichip_tp_serving_pred_vs_measured", 0.0),
     }
 
     out = {

@@ -18,6 +18,17 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
 
+# --tp N on a CPU host needs N virtual devices BEFORE jax initializes
+# (same trick as tests/conftest.py); a real TPU slice has real chips
+if "--tp" in _sys.argv and "xla_force_host_platform_device_count" not in \
+        _os.environ.get("XLA_FLAGS", ""):
+    try:
+        _n = max(2, int(_sys.argv[_sys.argv.index("--tp") + 1]))
+    except (ValueError, IndexError):
+        _n = 8
+    _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
+                                + f" --xla_force_host_platform_device_count={_n}").strip()
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -53,6 +64,24 @@ def main():
                          "block-aligned prompt prefixes into their page "
                          "table and prefill only the uncached suffix; "
                          "output tokens are identical either way")
+    ap.add_argument("--tp", type=int, default=None,
+                    help="tensor-parallel degree (ISSUE 11): shard the "
+                         "engine's compiled programs over a tp-way mesh "
+                         "via shard_map — weights column/row-sharded, "
+                         "the paged KV pool sharded by KV head, the "
+                         "host scheduler unchanged. Output tokens are "
+                         "identical to --tp 1. On CPU this uses the "
+                         "virtual-device mesh (the harness forces 8); "
+                         "on a TPU slice it shards over real chips. "
+                         "tp must divide num_heads/num_kv_heads")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="prefill/decode role separation (ISSUE 11, "
+                         "needs --prefill-chunk): mid-prompt slots "
+                         "stream chunks through the prefill-role "
+                         "program while decoding slots ride deep "
+                         "chains in the same step — long prompts never "
+                         "pin the decode batch to one token per round "
+                         "trip; output tokens are identical either way")
     ap.add_argument("--prefill-chunk", type=int, default=None,
                     help="chunked prefill (ISSUE 9): stream prompts "
                          "into the cache this many tokens per mixed "
@@ -144,7 +173,11 @@ def main():
                  max_queue=args.max_queue,
                  fault_plan=args.fault_inject,
                  prefix_cache=args.prefix_cache == "on",
-                 prefill_chunk=args.prefill_chunk)
+                 prefill_chunk=args.prefill_chunk,
+                 tp=args.tp, disaggregate=args.disaggregate)
+    if eng.runner.sharded:
+        print(f"tensor parallel: tp={eng.runner.tp} over "
+              f"{[str(d) for d in eng.runner.mesh.devices.flat]}")
     rng = np.random.default_rng(0)
 
     # mixed-length requests, more requests than slots: admission interleaves

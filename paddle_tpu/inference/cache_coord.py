@@ -1,0 +1,175 @@
+"""Cache-coordinator layer of the serving engine (ISSUE 11 tentpole).
+
+Owns the paged KV pool and everything that hands pages around:
+
+* the DEVICE page buffers (``k_pages``/``v_pages``/``scale_pages`` per
+  layer) — physically partitioned across the TP axis when the
+  model-runner is sharded (each shard holds its KV heads' lanes of
+  every page: layout ``[P, page_size, (Hkv/tp)*D]`` per shard);
+* the HOST-GLOBAL allocator: block tables, lengths, the per-page
+  refcounts, free lists — one copy, device-count-agnostic, so PR 8's
+  refcount/COW prefix-cache logic runs untouched whatever the mesh;
+* the prefix cache and the pending copy-on-write set;
+* pool reset for whole-step fault recovery — donated-dead buffers
+  rebuild PER-SHARD through the runner (a replicated host rebuild
+  would silently unshard the pool: the single-chip assumption this
+  split surfaced, ISSUE 11 satellite).
+
+This is also the prefill→decode handoff point of the disaggregated
+scheduler: a prefill-role step writes a prompt's pages into the shared
+pool and the decode-role batch picks the slot up at the very next
+boundary — streaming KV by table reference, never by copy (the
+DistServe-shaped move without its cross-worker transfer, because the
+pool is one sharded buffer).
+
+Engine-core reaches all of this through thin delegating properties, so
+the scheduler code (and its tests) read exactly as before the split.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .prefix_cache import PrefixCache
+
+__all__ = ["CacheCoordinator"]
+
+
+class CacheCoordinator:
+    """Paged KV pool + allocator; see module docstring."""
+
+    def __init__(self, engine, prefix_cache: bool = False):
+        self.engine = engine
+        cfg = engine.cfg
+        self.num_pages = engine.num_pages
+        self.page_size = engine.page_size
+        # host-global allocator state; page 0 reserved as the trash page
+        self.tables = np.zeros(
+            (engine.max_slots, engine.max_pages_per_seq), np.int32)
+        self.lengths = np.zeros((engine.max_slots,), np.int32)
+        self.page_ref = np.zeros((self.num_pages,), np.int32)
+        self.pcache = PrefixCache(self.page_size) if prefix_cache else None
+        self.cow_pending: List = []  # (src, dst) device copies owed
+        self.free_pages: List[int] = []
+        self.free_slots: List[int] = []
+        self.k_pages: List = []
+        self.v_pages: List = []
+        self.scale_pages: List = []
+        self.reset()
+
+    # ------------------------------------------------------------ pool
+    def reset(self):
+        """(Re)create the device page buffers and allocator free lists.
+        Construction AND whole-step fault recovery: after a failed
+        dispatch the donated buffers may be dead, but their content is
+        recomputable (every requeued request re-prefills), so a fresh
+        zeroed pool loses nothing. The buffers are placed through the
+        model-runner so a sharded pool rebuilds per-shard."""
+        eng = self.engine
+        cfg = eng.cfg
+        n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+        store = jnp.int8 if eng.quantized else eng.dtype
+        shape = (self.num_pages, self.page_size, n_kv * cfg.head_dim)
+        place = eng.runner.place_pages
+        self.k_pages = place([jnp.zeros(shape, store)
+                              for _ in range(cfg.num_layers)])
+        self.v_pages = place([jnp.zeros(shape, store)
+                              for _ in range(cfg.num_layers)])
+        if eng.quantized:
+            sshape = (self.num_pages, self.page_size, 128)
+            self.scale_pages = [jnp.zeros(sshape, jnp.bfloat16)
+                                for _ in range(cfg.num_layers)]
+        else:
+            self.scale_pages = [None] * cfg.num_layers
+        self.tables[:] = 0
+        self.lengths[:] = 0
+        self.free_pages = list(range(self.num_pages - 1, 0, -1))
+        self.free_slots = list(range(eng.max_slots - 1, -1, -1))
+        # the prefix cache maps token hashes to PAGE CONTENT — content
+        # that just died with the buffers; flush it and every refcount
+        self.page_ref[:] = 0
+        if self.pcache is not None:
+            self.pcache.clear()
+        self.cow_pending = []
+
+    def pages_flat(self) -> List:
+        out = list(self.k_pages) + list(self.v_pages)
+        if self.engine.quantized:
+            out += list(self.scale_pages)
+        return out
+
+    def set_pages(self, pages_flat):
+        """Host-side writeback after a jitted call returns."""
+        L = self.engine.cfg.num_layers
+        self.k_pages = list(pages_flat[:L])
+        self.v_pages = list(pages_flat[L:2 * L])
+        if self.engine.quantized:
+            self.scale_pages = list(pages_flat[2 * L:3 * L])
+
+    # ------------------------------------------------------- allocator
+    def alloc_page(self) -> Optional[int]:
+        """Claim one physical page (refcount 1): free list first, then
+        LRU eviction of an idle prefix-cache page — cached pages are
+        reclaimed BEFORE any active request is preempted."""
+        if self.free_pages:
+            page = self.free_pages.pop()
+        elif self.pcache is not None:
+            page = self.pcache.evict_lru(self.page_ref)
+            if page is None:
+                return None
+            m = self.engine._m
+            if m is not None:
+                m.pc_evictions.inc()
+        else:
+            return None
+        self.page_ref[page] = 1
+        return page
+
+    def release_page(self, page: int):
+        """Drop one reference; at refcount 0 the page returns to the
+        free list unless the prefix cache still maps content to it (it
+        then stays resident, LRU-evictable). The single release choke
+        point — shared pages can never double-free."""
+        page = int(page)
+        if page <= 0:
+            return
+        ref = int(self.page_ref[page]) - 1
+        assert ref >= 0, f"page {page} refcount went negative"
+        self.page_ref[page] = ref
+        if ref == 0 and not (self.pcache is not None
+                             and self.pcache.contains_page(page)):
+            self.free_pages.append(page)
+
+    def available_pages(self) -> int:
+        """Pages an allocation burst could claim (free + idle cached —
+        an upper bound, see evictable_count)."""
+        n = len(self.free_pages)
+        if self.pcache is not None:
+            n += self.pcache.evictable_count(self.page_ref)
+        return n
+
+    # ----------------------------------------------------- COW / faults
+    def flush_cow(self, copy_fn):
+        """Flush pending copy-on-write page duplications in one device
+        dispatch — owed BEFORE any program writes into a spliced table.
+        ``copy_fn(pages_flat, src, dst) -> pages_flat`` is the engine's
+        donated jit helper (sharding-preserving: page-index scatters
+        never touch the lane axis the pool shards on)."""
+        if self.cow_pending:
+            src = np.asarray([s for s, _ in self.cow_pending], np.int32)
+            dst = np.asarray([d for _, d in self.cow_pending], np.int32)
+            self.set_pages(copy_fn(self.pages_flat(), jnp.asarray(src),
+                                   jnp.asarray(dst)))
+            self.cow_pending = []
+
+    def corrupt_page(self, page: int):
+        """``prefix-cache-corruption`` fault-injection damage: garbage
+        layer-0 K rows for one cached page (safe — pages are only read
+        below ``lengths``; see Engine._corrupt_page docstring history)."""
+        eng = self.engine
+        garbage = jnp.full(self.k_pages[0].shape[1:],
+                           57 if eng.quantized else 1e3,
+                           self.k_pages[0].dtype)
+        self.k_pages[0] = self.k_pages[0].at[int(page)].set(garbage)

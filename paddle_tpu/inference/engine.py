@@ -110,6 +110,22 @@ output. TPU-first design instead of a C++ executor loop:
   token-emitting rows, and the prefix cache splices/registers precisely
   as in the unchunked path — output streams are identical chunked on or
   off (``tests/test_chunked_prefill.py``, ``make chaos``).
+* **Tensor-parallel serving (ISSUE 11).** The engine is split into
+  engine-core (THIS module: the host scheduler — admission, harvest,
+  retries, watchdog; device-count-agnostic), model-runner
+  (``inference/runner.py``: the compiled programs and, with
+  ``Engine(tp=N)``, the TP mesh they trace under — weights column/
+  row-sharded via ``shard_map``, the paged pool sharded by KV head,
+  host operands replicated) and cache-coordinator
+  (``inference/cache_coord.py``: pool + refcount allocator + prefix
+  cache; page tables host-global, device buffers per-shard). On top,
+  ``Engine(disaggregate=True)`` separates prefill/decode ROLES within
+  a scheduling step: mid-prompt slots stream chunks through the mixed
+  program while decoding slots ride deep chains, one harvest fence,
+  pages handed over through the shared pool. Token streams are
+  bit-identical to the single-chip engine in every mode
+  (``tests/test_tp_serving.py``); the sharded programs are statically
+  gated by tpushard (``make analyze --mesh 1 --mesh 4 --mesh 8``).
 * **Continuous telemetry (ISSUE 3).** Every scheduling step records the
   vLLM/Orca-style operational surface into the process-global metrics
   registry (``paddle_tpu.observability``): TTFT/TPOT/queue-wait
@@ -154,7 +170,6 @@ from .errors import (
     ValidationError,
     failure_reason,
 )
-from .prefix_cache import PrefixCache
 from .watchdog import Watchdog
 
 
@@ -436,7 +451,8 @@ class Engine:
                  deadline_s: Optional[float] = None, max_retries: int = 8,
                  fault_plan=None, watchdog: Optional[dict] = None,
                  prefix_cache: bool = False,
-                 prefill_chunk: Optional[int] = None):
+                 prefill_chunk: Optional[int] = None,
+                 tp: Optional[int] = None, disaggregate: bool = False):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -456,23 +472,17 @@ class Engine:
         self.quantized = bool(quantized_cache)
         self.max_pages_per_seq = cfg.max_position // page_size
         self.num_pages = num_pages
-        # host-side allocator state; page 0 reserved as the trash page.
-        # Device page buffers + free lists are (re)built by _reset_pool —
-        # shared with whole-step fault recovery, which recreates the
-        # buffers from scratch because every requeued request re-prefills
-        # its prefix anyway (recompute policy).
-        self.tables = np.zeros((max_slots, self.max_pages_per_seq), np.int32)
-        self.lengths = np.zeros((max_slots,), np.int32)
-        # prefix caching (ISSUE 8): every physical page carries a refcount
-        # (slot + pre-admission-row references); the cache indexes pages
-        # whose content (a block-aligned token prefix) is known, so a new
-        # request's admission splices matched pages into its table and
-        # prefills only the uncached suffix. Pages referenced only by the
-        # cache (refcount 0) are resident-but-idle — LRU-evicted under
-        # pool pressure BEFORE any active request is preempted.
-        self._page_ref = np.zeros((num_pages,), np.int32)
-        self._pcache = PrefixCache(page_size) if prefix_cache else None
-        self._cow_pending = []  # (src, dst) device copies owed pre-wave
+        # model-runner (ISSUE 11 tentpole): owns the compiled programs
+        # and — at tp>1 — the tensor-parallel mesh they trace under
+        # (weights column/row-sharded, KV pool head-sharded, host
+        # operands replicated; one shard_map per dispatch). The
+        # scheduler below stays device-count-agnostic.
+        from .runner import ModelRunner
+
+        self.runner = ModelRunner(self, tp)
+        # compiled-program shapes quantize to this (watchdog batch
+        # shrink must keep slot caps mesh-aligned — ISSUE 11 satellite)
+        self._batch_quantum = self.runner.tp if self.runner.sharded else 1
         # chunked prefill (ISSUE 9): prompts stream into the cache
         # prefill_chunk tokens per mixed step instead of one bucketed
         # prefill dispatch; _chunk_left maps a mid-prefill slot to the
@@ -487,17 +497,29 @@ class Engine:
                     f"prefill_chunk={prefill_chunk} must be in "
                     f"[2, max_position={cfg.max_position}]")
         self.prefill_chunk = prefill_chunk
+        # prefill/decode role disaggregation (ISSUE 11): prefill-role
+        # slots stream chunks through the mixed program while
+        # decode-role slots ride deep chains in the SAME scheduling
+        # step, pages handed over through the cache-coordinator
+        self.disaggregate = bool(disaggregate)
+        if self.disaggregate and prefill_chunk is None:
+            raise ValueError(
+                "disaggregate=True requires prefill_chunk (prefill-role "
+                "steps stream prompts chunk-by-chunk)")
         self._chunk_left: Dict[int, np.ndarray] = {}
-        self._mixed_fns = {}  # (rows bucket, sampling) -> compiled step
-        self._reset_pool()
+        # cache-coordinator (ISSUE 11 tentpole): the paged pool +
+        # allocator + prefix cache. Page tables and refcounts stay
+        # host-global (PR 8's COW logic untouched); the device buffers
+        # partition across the TP axis when the runner is sharded.
+        from .cache_coord import CacheCoordinator
+
+        self._cache = CacheCoordinator(self, prefix_cache=prefix_cache)
         self._queue: List[Request] = []
         self._active: Dict[int, Request] = {}  # slot -> request
         self._last_tok = np.zeros((max_slots,), np.int32)
         self._temps = np.zeros((max_slots,), np.float32)
         self._keys = np.zeros((max_slots, 2), np.uint32)
         self._next_rid = 0
-        self._decode_fns = {}   # pow2 active-slot bucket -> compiled chunk
-        self._prefill_fns = {}  # (pow2 rows, pow2 seq bucket) -> compiled
         self._chain_time_ema = {}   # depth k -> EMA step wall seconds
         self._chain_obs = 0          # pure-decode steps observed
         self._probe_budget = 2       # bounded depth-calibration probes
@@ -509,7 +531,10 @@ class Engine:
         self._swap = [p for _, p in model.named_parameters()]
         self._swap += [b for _, b in model.named_buffers()
                        if b is not None]
-        self._params = [t._data for t in self._swap]
+        # placed ONCE on the runner's mesh (column/row shards at tp>1),
+        # so no dispatch ever re-shards the weights
+        self._params = self.runner.place_params(
+            [t._data for t in self._swap])
         # process-global serving telemetry; metrics=False drops every
         # record site to a single None check (the microbenchmarked
         # baseline for the <1% overhead budget, tools/mb_metrics.py)
@@ -543,6 +568,87 @@ class Engine:
         self._spec_enabled = True
         self._slot_cap = max_slots
         self._watchdog = Watchdog(self, **(watchdog or {}))
+
+    # --------------------------------------------- engine-core delegation
+    # The tentpole split (ISSUE 11) moved pool/allocator state into the
+    # cache-coordinator and program caches into the model-runner; the
+    # scheduler (and its tests) keep reading them through these
+    # delegators, so PR 6-9's host logic runs textually unchanged.
+    @property
+    def tables(self):
+        return self._cache.tables
+
+    @property
+    def lengths(self):
+        return self._cache.lengths
+
+    @property
+    def _page_ref(self):
+        return self._cache.page_ref
+
+    @property
+    def _pcache(self):
+        return self._cache.pcache
+
+    @property
+    def _cow_pending(self):
+        return self._cache.cow_pending
+
+    @_cow_pending.setter
+    def _cow_pending(self, v):
+        self._cache.cow_pending = v
+
+    @property
+    def _free_pages(self):
+        return self._cache.free_pages
+
+    @_free_pages.setter
+    def _free_pages(self, v):
+        self._cache.free_pages = v
+
+    @property
+    def _free_slots(self):
+        return self._cache.free_slots
+
+    @_free_slots.setter
+    def _free_slots(self, v):
+        self._cache.free_slots = v
+
+    @property
+    def k_pages(self):
+        return self._cache.k_pages
+
+    @k_pages.setter
+    def k_pages(self, v):
+        self._cache.k_pages = v
+
+    @property
+    def v_pages(self):
+        return self._cache.v_pages
+
+    @v_pages.setter
+    def v_pages(self, v):
+        self._cache.v_pages = v
+
+    @property
+    def scale_pages(self):
+        return self._cache.scale_pages
+
+    @scale_pages.setter
+    def scale_pages(self, v):
+        self._cache.scale_pages = v
+
+    @property
+    def _decode_fns(self):
+        return self.runner.decode_fns
+
+    @property
+    def _prefill_fns(self):
+        return self.runner.prefill_fns
+
+    @property
+    def _mixed_fns(self):
+        return self.runner.mixed_fns
 
     # ------------------------------------------------------------- requests
     def _reject(self, exc):
@@ -700,48 +806,18 @@ class Engine:
         return (int(length) + self.page_size - 1) // self.page_size
 
     def _alloc_page(self) -> Optional[int]:
-        """Claim one physical page (refcount 1): the free list first, then
-        LRU eviction of an idle prefix-cache page (refcount 0, leaf block)
-        — so under pool pressure cached pages are reclaimed BEFORE the
-        chain shrinks or any active request is preempted. Returns None
-        only when every page is live-referenced or unreclaimably cached."""
-        if self._free_pages:
-            page = self._free_pages.pop()
-        elif self._pcache is not None:
-            page = self._pcache.evict_lru(self._page_ref)
-            if page is None:
-                return None
-            if self._m is not None:
-                self._m.pc_evictions.inc()
-        else:
-            return None
-        self._page_ref[page] = 1
-        return page
+        """Claim one physical page — see CacheCoordinator.alloc_page
+        (free list first, then LRU eviction of an idle cached page)."""
+        return self._cache.alloc_page()
 
     def _release_page(self, page):
-        """Drop one reference to a physical page. At refcount 0 the page
-        returns to the free list — unless the prefix cache still maps
-        content to it, in which case it stays resident (idle, LRU-
-        evictable) for future splices. The single release choke point:
-        slot frees, trims, row frees and allocation rollbacks all funnel
-        here, so a shared page can never be double-freed."""
-        page = int(page)
-        if page <= 0:
-            return
-        ref = int(self._page_ref[page]) - 1
-        assert ref >= 0, f"page {page} refcount went negative"
-        self._page_ref[page] = ref
-        if ref == 0 and not (self._pcache is not None
-                             and self._pcache.contains_page(page)):
-            self._free_pages.append(page)
+        """Drop one page reference — see CacheCoordinator.release_page
+        (the single release choke point; shared pages never double-free)."""
+        self._cache.release_page(page)
 
     def _available_pages(self) -> int:
-        """Pages an allocation burst could claim: the free list plus idle
-        cached pages (an upper bound — see evictable_count)."""
-        n = len(self._free_pages)
-        if self._pcache is not None:
-            n += self._pcache.evictable_count(self._page_ref)
-        return n
+        """Pages an allocation burst could claim (free + idle cached)."""
+        return self._cache.available_pages()
 
     def _ensure_pages(self, slot, new_len):
         need = self._pages_needed(new_len)
@@ -861,10 +937,7 @@ class Engine:
         next owner rewrites during its own prefill/decode before they
         become visible — so with the invalidate-on-doubt path routing
         lookups around it, the flip can cost a miss but never a token."""
-        garbage = jnp.full(self.k_pages[0].shape[1:],
-                           57 if self.quantized else 1e3,
-                           self.k_pages[0].dtype)
-        self.k_pages[0] = self.k_pages[0].at[int(page)].set(garbage)
+        self._cache.corrupt_page(page)
 
     def _register_prefix(self, prefix, row):
         """Publish the freshly prefilled FULL pages of ``prefix`` into the
@@ -947,43 +1020,14 @@ class Engine:
             self._spec.drafter.release(slot)
 
     def _reset_pool(self):
-        """(Re)create the device page buffers and allocator free lists.
-        Used at construction AND by whole-step fault recovery: after a
-        failed dispatch the donated page buffers may be dead, but their
-        CONTENT is entirely recomputable — every requeued request
-        re-prefills its prompt+generated prefix on re-admission, so a
-        fresh zeroed pool loses nothing."""
-        cfg = self.cfg
-        n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
-        store = jnp.int8 if self.quantized else self.dtype
-        # slab page layout [P, page_size, Hkv*D] (contiguous 128-lane rows;
-        # see paged_slab_decode_attention for why this beats per-head pages)
-        shape = (self.num_pages, self.page_size, n_kv * cfg.head_dim)
-        self.k_pages = [jnp.zeros(shape, store)
-                        for _ in range(cfg.num_layers)]
-        self.v_pages = [jnp.zeros(shape, store)
-                        for _ in range(cfg.num_layers)]
-        if self.quantized:
-            # per-token-per-head bf16 scales packed into 128-lane pages
-            # (k at lanes [0, Hkv), v at [Hkv, 2Hkv))
-            sshape = (self.num_pages, self.page_size, 128)
-            self.scale_pages = [jnp.zeros(sshape, jnp.bfloat16)
-                                for _ in range(cfg.num_layers)]
-        else:
-            self.scale_pages = [None] * cfg.num_layers
-        self.tables[:] = 0
-        self.lengths[:] = 0
-        self._free_pages = list(range(self.num_pages - 1, 0, -1))
-        self._free_slots = list(range(self.max_slots - 1, -1, -1))
-        # the prefix cache maps token hashes to PAGE CONTENT — content
-        # that just died with the buffers. Flush it (and zero every
-        # refcount) or post-recovery admissions would splice pages whose
-        # bytes are fresh zeros: stale-pointer corruption (ISSUE 8
-        # satellite — step-fault recovery must never serve stale pages)
-        self._page_ref[:] = 0
-        if self._pcache is not None:
-            self._pcache.clear()
-        self._cow_pending = []
+        """(Re)create the device page buffers and allocator free lists —
+        delegated to the cache-coordinator, which rebuilds a sharded
+        pool PER-SHARD (donated-dead buffers after a failed dispatch
+        must come back with the same mesh placement, ISSUE 11
+        satellite). Content is entirely recomputable: every requeued
+        request re-prefills its prompt+generated prefix on re-admission,
+        so a fresh zeroed pool loses nothing."""
+        self._cache.reset()
         # mid-prefill progress refers to pages that just died; requeued
         # requests re-chunk from scratch (recompute policy)
         if getattr(self, "_chunk_left", None):
@@ -1070,17 +1114,10 @@ class Engine:
 
     def _set_pages(self, pages_flat):
         """Host-side writeback after a jitted call returns."""
-        L = self.cfg.num_layers
-        self.k_pages = list(pages_flat[:L])
-        self.v_pages = list(pages_flat[L:2 * L])
-        if self.quantized:
-            self.scale_pages = list(pages_flat[2 * L:3 * L])
+        self._cache.set_pages(pages_flat)
 
     def _pages_flat(self):
-        out = list(self.k_pages) + list(self.v_pages)
-        if self.quantized:
-            out += list(self.scale_pages)
-        return out
+        return self._cache.pages_flat()
 
     def _select_token(self, logits, greedy_tok, temps, keys):
         """Shared prefill/decode token selection: argmax where temp == 0,
@@ -1100,10 +1137,10 @@ class Engine:
         new_keys = jnp.where((temps > 0.0)[:, None], new_keys, keys)
         return tok, new_keys
 
-    def _get_prefill(self, bucket, sampling, suffix=False):
-        """One compiled prefill per (pow2 row count, pow2 prompt bucket,
-        sampling?, suffix?): a whole admission wave in one dispatch.
-        Greedy-only waves compile without the sampling machinery.
+    def _make_prefill_raw(self, sampling, suffix=False):
+        """Raw (unjitted) bucketed-prefill program — one per (sampling?,
+        suffix?); the model-runner wraps it (jit, plus shard_map at
+        tp>1) and caches per pow2 bucket.
 
         ``suffix=True`` is the prefix-cache partial-prefill program
         (ISSUE 8): ``lengths_rows`` carries each row's cached token count
@@ -1114,16 +1151,8 @@ class Engine:
         All-miss waves keep this ``suffix=False`` program — bitwise the
         cache-off path, so zero-overlap traffic never pays for the
         cache."""
-        key = (bucket, sampling, suffix)
-        if key in self._prefill_fns:
-            return self._prefill_fns[key]
-        if self._m is not None:
-            self._m.compiled.labels(kind="prefill").inc()
         model, engine = self.model, self
 
-        import functools
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, pages_flat, ids, valid, tables_rows,
                     lengths_rows, temps, keys):
             from ..jit import swapped_tensors
@@ -1151,27 +1180,23 @@ class Engine:
                     tok, new_keys = greedy, keys
                 return tok, new_keys, bad, engine._pages_of(new_states)
 
-        self._prefill_fns[key] = prefill
         return prefill
 
-    def _get_decode(self, nb, k, sampling):
-        """One compiled decode program per (pow2 active-slot bucket ``nb``,
-        pow2 chain depth ``k``, sampling?): a single ``lax.scan`` of
-        ``k * chunk_size`` steps, so a whole chain costs ONE dispatch +
-        ONE fetch (on the tunneled chip a dispatch is ~50–100 ms —
-        chaining k separate chunk dispatches still paid it k times).
-        Greedy-only batches (``sampling=False``, the common serving case)
-        compile without the per-step vocab-wide sampling draw."""
-        if (nb, k, sampling) in self._decode_fns:
-            return self._decode_fns[(nb, k, sampling)]
-        if self._m is not None:
-            self._m.compiled.labels(kind="decode").inc()
+    def _get_prefill(self, bucket, sampling, suffix=False):
+        """One compiled prefill per (pow2 row count, pow2 prompt bucket,
+        sampling?, suffix?): a whole admission wave in one dispatch.
+        Greedy-only waves compile without the sampling machinery."""
+        return self.runner.get_prefill(bucket, sampling, suffix)
+
+    def _make_decode_raw(self, k, sampling):
+        """Raw (unjitted) chained-decode program: a single ``lax.scan``
+        of ``k * chunk_size`` steps — the model-runner wraps it (jit +
+        shard_map at tp>1; the scan carries the page shards LOCALLY, so
+        no reshard crosses a step boundary — the tpushard TPC502
+        property the sharded chain is gated on)."""
         model, engine = self.model, self
         steps = k * self.chunk_size
 
-        import functools
-
-        @functools.partial(jax.jit, donate_argnums=(1,))
         def decode_chain(params, pages_flat, tables, lengths, last_tok,
                          temps, keys):
             from ..jit import swapped_tensors
@@ -1204,8 +1229,16 @@ class Engine:
                     length=steps)
             return jnp.swapaxes(toks, 0, 1), pages_flat, lengths, keys, bad
 
-        self._decode_fns[(nb, k, sampling)] = decode_chain
         return decode_chain
+
+    def _get_decode(self, nb, k, sampling):
+        """One compiled decode program per (pow2 active-slot bucket ``nb``,
+        pow2 chain depth ``k``, sampling?): a whole chain costs ONE
+        dispatch + ONE fetch (on the tunneled chip a dispatch is
+        ~50–100 ms — chaining k separate chunk dispatches still paid it
+        k times). Greedy-only batches compile without the per-step
+        vocab-wide sampling draw."""
+        return self.runner.get_decode(nb, k, sampling)
 
     def _get_mixed(self, nb, sampling):
         """ONE compiled mixed chunk+decode step per sampling flag
@@ -1214,17 +1247,7 @@ class Engine:
         compile surface is this program plus the decode chains — no
         prompt-length prefill buckets, which is what lets a cold server's
         first wave approach steady-state throughput."""
-        key = (nb, sampling)
-        if key in self._mixed_fns:
-            return self._mixed_fns[key]
-        if self._m is not None:
-            self._m.compiled.labels(kind="mixed").inc()
-        import functools
-
-        fn = functools.partial(jax.jit, donate_argnums=(1,))(
-            make_mixed_step_fn(self, sampling))
-        self._mixed_fns[key] = fn
-        return fn
+        return self.runner.get_mixed(nb, sampling)
 
     # ------------------------------------------------------------ scheduling
     @staticmethod
@@ -1718,16 +1741,11 @@ class Engine:
         return bool(self._queue) and bool(self._free_slots) \
             and len(self._active) < self._slot_cap
 
-    def _mixed_step(self):
-        """Chunked-prefill scheduling iteration (ISSUE 9 tentpole b).
-        Admission binds queued requests to slots WITHOUT a prefill
-        dispatch — their first chunk rides this very step — then one
-        fixed-shape mixed program advances every active slot: decoding
-        slots by one token, prefilling slots by up to ``prefill_chunk``
-        prompt tokens. Long prompts never stall the decode batch (decode
-        tokens land every step while the prompt streams in), pages
-        allocate chunk-by-chunk instead of prompt-at-once, and the whole
-        wave harvests with one blocking fetch."""
+    def _bind_chunked(self):
+        """Chunked-mode admission: bind queued requests to slots WITHOUT
+        a prefill dispatch — their first chunk rides the very next mixed
+        (or disaggregated prefill-role) step. Shared by ``_mixed_step``
+        and ``_disagg_step``."""
         chunk = self.prefill_chunk
         while (self._queue and self._free_slots
                and len(self._active) < self._slot_cap):
@@ -1776,6 +1794,19 @@ class Engine:
                     np.uint32)
             self._keys[slot] = req._key
             self._note_admitted(req)
+
+    def _mixed_step(self):
+        """Chunked-prefill scheduling iteration (ISSUE 9 tentpole b).
+        Admission binds queued requests to slots WITHOUT a prefill
+        dispatch — their first chunk rides this very step — then one
+        fixed-shape mixed program advances every active slot: decoding
+        slots by one token, prefilling slots by up to ``prefill_chunk``
+        prompt tokens. Long prompts never stall the decode batch (decode
+        tokens land every step while the prompt streams in), pages
+        allocate chunk-by-chunk instead of prompt-at-once, and the whole
+        wave harvests with one blocking fetch."""
+        chunk = self.prefill_chunk
+        self._bind_chunked()
         if not self._active:
             if self._queue:
                 self._note_stall()
@@ -1796,7 +1827,18 @@ class Engine:
         self._reserve_step_pages(1, target)
         if not self._active:
             return
-        slots = sorted(self._active)
+        slots, widths, tok_d, keys_d, bad_d = self._mixed_dispatch(
+            sorted(self._active))
+        tok, keys_h, bad_h = (np.asarray(a) for a in jax.device_get(
+            (tok_d, keys_d, bad_d)))
+        self._mixed_harvest(slots, widths, tok, keys_h, bad_h)
+
+    def _mixed_dispatch(self, slots):
+        """Build + dispatch ONE mixed chunk+decode program over exactly
+        ``slots`` (rows pad to the fixed max_slots bucket; slots not
+        listed — e.g. the decode-role batch of a disaggregated step —
+        simply aren't rows). Returns device handles; never blocks."""
+        chunk = self.prefill_chunk
         n = len(slots)
         nb = _pow2ceil(self.max_slots)
         ids = np.zeros((nb, chunk), np.int32)
@@ -1838,8 +1880,11 @@ class Engine:
             jnp.asarray(tables_c), jnp.asarray(lengths_c),
             jnp.asarray(temps_c), jnp.asarray(keys_c))
         self._set_pages(pages)
-        tok, keys_h, bad_h = (np.asarray(a) for a in jax.device_get(
-            (tok_d, keys_d, bad_d)))
+        return slots, widths, tok_d, keys_d, bad_d
+
+    def _mixed_harvest(self, slots, widths, tok, keys_h, bad_h):
+        """Host harvest of a mixed dispatch: advance chunk state, take
+        tokens from emitting rows, per-request fault isolation."""
         cap = self.max_pages_per_seq * self.page_size
         for i, slot in enumerate(slots):
             req = self._active.get(slot)
@@ -1884,6 +1929,163 @@ class Engine:
             except Exception as e:
                 self._fail_request(req, self._wrap_step_fault(e, req))
 
+    # ------------------------------- prefill/decode disaggregation (ISSUE 11)
+    def _chain_dispatch(self, slots, k):
+        """Dispatch a decode chain over exactly ``slots`` (compacted to
+        their own pow2 bucket) — the decode-role half of a disaggregated
+        step. No admission splicing, no pre-admission: those belong to
+        the chunked admission path. Returns the chain tuple; never
+        blocks."""
+        slot_reqs = [self._active[s] for s in slots]
+        n = len(slots)
+        nb = _pow2ceil(n)
+        if self._m is not None:
+            self._m.chain_depth_at(k).inc()
+            self._m.decode_batch.observe(n)
+        tables_c = np.zeros((nb, self.max_pages_per_seq), np.int32)
+        lengths_c = np.zeros((nb,), np.int32)
+        last_c = np.zeros((nb,), np.int32)
+        temps_c = np.zeros((nb,), np.float32)
+        keys_c = np.zeros((nb, 2), np.uint32)
+        tables_c[:n] = self.tables[slots]
+        lengths_c[:n] = self.lengths[slots]
+        last_c[:n] = self._last_tok[slots]
+        temps_c[:n] = self._temps[slots]
+        keys_c[:n] = self._keys[slots]
+        sampling = bool(np.any(temps_c > 0.0))
+        decode = self._get_decode(nb, k, sampling)
+        toks_d, pages, lengths_d, keys_d, bad_d = decode(
+            self._params, self._pages_flat(), jnp.asarray(tables_c),
+            jnp.asarray(lengths_c), jnp.asarray(last_c),
+            jnp.asarray(temps_c), jnp.asarray(keys_c))
+        self._set_pages(pages)
+        return (slots, slot_reqs, toks_d, lengths_d, keys_d, bad_d)
+
+    def _chain_harvest(self, slots, slot_reqs, toks, lengths_h, keys_h,
+                       bad_h):
+        """Host harvest of a decode chain (per-request isolation — the
+        same contract as the vanilla chained step's harvest loop)."""
+        for i, (slot, req) in enumerate(zip(slots, slot_reqs)):
+            if req.done and req.slot is None:
+                continue  # finished elsewhere this step; slot freed
+            if req.slot != slot:
+                continue  # preempted mid-step; chain row is garbage
+            try:
+                if self._fi is not None:
+                    if self._fi.fire("step-exception", rid=req.rid):
+                        raise InjectedFault(
+                            f"injected step fault (rid {req.rid})")
+                    if self._fi.fire("nan-logits", rid=req.rid):
+                        raise NumericsError(
+                            "injected non-finite logits", rid=req.rid)
+                if bad_h[i]:
+                    raise NumericsError(
+                        "non-finite logits in decode chain", rid=req.rid)
+                self._harvest(req, toks[i])
+                self._last_tok[slot] = int(toks[i, -1])
+                self.lengths[slot] = int(lengths_h[i])
+                self._keys[slot] = keys_h[i]
+                if req.done:
+                    del self._active[slot]
+                    self._free_slot(slot)
+            except RequestError as e:
+                self._fail_request(req, e)
+            except Exception as e:
+                self._fail_request(req, self._wrap_step_fault(e, req))
+
+    def _disagg_step(self):
+        """Prefill/decode role disaggregation (ISSUE 11 tentpole): one
+        scheduling step dispatches the PREFILL-ROLE program (the mixed
+        chunk step over mid-prompt slots — streaming each prompt
+        ``prefill_chunk`` tokens into the shared pool) and the
+        DECODE-ROLE chain (depth-k over fully-prefilled slots)
+        back-to-back, then harvests both with ONE blocking fetch.
+
+        Versus the plain mixed step — which locks every decoding slot to
+        ONE token per host round trip while any prompt streams — decode
+        slots keep their deep chains (k·chunk_size tokens per round
+        trip) while long prompts trickle in beside them: the
+        DistServe/vLLM prefill-decode separation, in-process, with the
+        cache-coordinator's shared (possibly TP-sharded) pool as the
+        page handoff instead of a cross-worker KV transfer. A prompt
+        whose final chunk lands this step emits its first token here
+        and joins the decode-role batch at the very next boundary —
+        that handoff is the "stream finished KV pages to the decode
+        batch" edge, and prefix-cache hits ride it too (spliced pages
+        skip the prefill role entirely).
+
+        Token streams are identical to the mixed step's (and so to the
+        single-chip engine's): per-token computation and key burns are
+        unchanged, only WHICH program advances a slot differs —
+        asserted by tests/test_tp_serving.py across greedy/sampled/
+        cache/chaos scenarios."""
+        chunk = self.prefill_chunk
+        self._bind_chunked()
+        if not self._active:
+            if self._queue:
+                self._note_stall()
+            return
+        self._stall_steps = 0
+        dec = [s for s in sorted(self._active)
+               if s not in self._chunk_left]
+        k = 1
+        if dec:
+            # chain depth over the decode-role batch only (the useful-
+            # tokens-per-round-trip maximizer, with the eos turnover
+            # clamp — same policy as _chain_depth, scoped to dec slots)
+            rem = [self._active[s].max_new_tokens
+                   - len(self._active[s].tokens) for s in dec]
+            kmax = self.max_chain
+            if self._queue and self.eos_id is not None:
+                kmax = min(kmax, max(1, -(-min(rem) // self.chunk_size)))
+            cost = self._boundary_cost_chunks()
+            best_k, best_u = 1, -1.0
+            kk = 1
+            while kk <= kmax:
+                useful = sum(min(r, kk * self.chunk_size) for r in rem)
+                u = useful / (cost + kk)
+                if u > best_u:
+                    best_k, best_u = kk, u
+                kk *= 2
+            k = best_k
+
+        def target(slot, req, kk):
+            left = self._chunk_left.get(slot)
+            if left is not None:
+                return int(self.lengths[slot]) + min(left.size, chunk)
+            return self._alloc_len(req, kk)
+
+        # role-aware page reservation: chunk slots need one chunk, chain
+        # slots k*chunk_size — the shared shrink→preempt→fail ladder
+        # halves k under pressure before anyone is evicted
+        k = self._reserve_step_pages(k, target)
+        if not self._active:
+            return
+        k = max(1, k)
+        pre = [s for s in sorted(self._active) if s in self._chunk_left]
+        dec = [s for s in sorted(self._active)
+               if s not in self._chunk_left]
+        mixed_d = self._mixed_dispatch(pre) if pre else None
+        chain = self._chain_dispatch(dec, k) if dec else None
+        # ---- single harvest fence for both roles ----
+        handles = []
+        if mixed_d is not None:
+            handles += list(mixed_d[2:])
+        if chain is not None:
+            handles += list(chain[2:])
+        fetched = jax.device_get(tuple(handles))
+        off = 0
+        if mixed_d is not None:
+            tok, keys_h, bad_h = (np.asarray(a) for a in fetched[:3])
+            self._mixed_harvest(mixed_d[0], mixed_d[1], tok, keys_h,
+                                bad_h)
+            off = 3
+        if chain is not None:
+            toks, lengths_h, keys_h, bad_h = (
+                np.asarray(a) for a in fetched[off:off + 4])
+            self._chain_harvest(chain[0], chain[1], toks, lengths_h,
+                                keys_h, bad_h)
+
     def step(self) -> int:
         """One scheduling iteration. NEVER raises (ISSUE 6): request-
         scoped faults fail the one request (terminal FAILED with a
@@ -1899,7 +2101,10 @@ class Engine:
             self._expire_deadlines()
         try:
             if self._wants_mixed():
-                self._mixed_step()
+                if self.disaggregate:
+                    self._disagg_step()
+                else:
+                    self._mixed_step()
             elif self._spec is not None and self._spec_enabled:
                 self._spec_step()
             else:

@@ -107,12 +107,10 @@ class SpecDecoder:
     def get_verify(self, nb: int, sampling: bool):
         fn = self._verify_fns.get(sampling)
         if fn is None:
-            import functools
-
-            import jax
-
             raw = make_verify_fn(self.engine, sampling)
-            fn = functools.partial(jax.jit, donate_argnums=(1,))(raw)
+            # the model-runner wraps (jit, plus shard_map at tp>1 —
+            # verify rides the same sharded weights/pool as decode)
+            fn = self.engine.runner.wrap_verify(raw)
             self._verify_fns[sampling] = fn
         shape = (nb, self.k, sampling)
         if shape not in self._seen_shapes:

@@ -123,8 +123,19 @@ class Watchdog:
     def _apply(self):
         eng = self.engine
         eng._spec_enabled = self.level < NO_SPEC
-        eng._slot_cap = (eng.max_slots if self.level < SMALL_BATCH
-                         else max(1, eng.max_slots // 2))
+        cap = (eng.max_slots if self.level < SMALL_BATCH
+               else max(1, eng.max_slots // 2))
+        # mesh-aligned batch shrink (ISSUE 11 satellite): a sharded
+        # engine quantizes compiled-program shapes to _batch_quantum
+        # (the TP degree) — a degraded cap that drops off that grid
+        # would make every post-degradation step a novel bucket shape
+        # (a recompile storm exactly when the engine is least healthy),
+        # so round the halved cap UP to the quantum, clamped at
+        # max_slots. Single-chip engines have quantum 1: unchanged.
+        q = max(1, int(getattr(eng, "_batch_quantum", 1)))
+        if q > 1:
+            cap = min(eng.max_slots, -(-cap // q) * q)
+        eng._slot_cap = cap
         if eng._m is not None:
             eng._m.degraded.set(self.level)
 

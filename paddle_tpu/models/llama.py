@@ -82,6 +82,18 @@ def _is_paged(cache) -> bool:
     return _gpt_is_paged(cache)
 
 
+def _tp_reduce(t, axis):
+    """The Megatron ``g`` collective of a row-parallel projection: sum
+    the per-shard partial products over the tensor-parallel axis. The
+    serving model-runner (``inference/runner.py``) arms ``_tp_axis`` on
+    attention/MLP modules only for the duration of a sharded trace —
+    everywhere else ``axis`` is None and this is the identity, so the
+    single-chip path is textually and bitwise unchanged."""
+    if axis is None:
+        return t
+    return apply_op(lambda a: jax.lax.psum(a, axis), t)
+
+
 class LlamaAttention(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -160,7 +172,13 @@ class LlamaAttention(nn.Layer):
                 lambda c, qa, ka, va: cache_decode_step(
                     c, qa, ka, va, time_step),
                 cache, q, k, v)
-        out = self.o_proj(out.reshape([b, s, nh * hd]))
+        # nh here is the LOCAL head count under a sharded trace (the
+        # runner's local_view divides it), so the reshape and the
+        # row-parallel o_proj consume exactly this shard's heads; the
+        # psum reassembles the full projection (bias-free, so partial
+        # sums add exactly)
+        out = _tp_reduce(self.o_proj(out.reshape([b, s, nh * hd])),
+                         getattr(self, "_tp_axis", None))
         if cache is not None:
             return out, new_cache
         return out
@@ -177,7 +195,13 @@ class LlamaMLP(nn.Layer):
         self.down_proj = nn.Linear(m, h, bias_attr=False)
 
     def forward(self, x):
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+        # gate/up are column-sharded under a TP trace (each shard holds
+        # an FF slice), down is row-sharded; the psum after down is the
+        # MLP's Megatron g collective (identity off-mesh — see
+        # _tp_reduce)
+        return _tp_reduce(
+            self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x)),
+            getattr(self, "_tp_axis", None))
 
 
 class LlamaBlock(nn.Layer):

@@ -452,9 +452,22 @@ def paged_slab_decode_attention(q, k_pages, v_pages, block_tables, lengths,
     int8 path: data pages are int8 with per-token-per-head symmetric
     scales packed into a 128-lane scale page (k scales at lanes [0, Hkv),
     v scales at [Hkv, 2*Hkv) — a full-lane minor so the page tiles/DMAs,
-    unlike a [.., Hkv]-minor scale array). Returns [B, H, D]."""
+    unlike a [.., Hkv]-minor scale array). Returns [B, H, D].
+
+    Sharded-pool dispatch (ISSUE 11): every shape here may be a PER-SHARD
+    view — under the serving runner's ``shard_map`` the pool arrives as
+    ``[P, page_size, (Hkv/tp)*D]`` and q as the shard's ``H/tp`` heads.
+    The kernel/ref math is already local (head counts derive from the
+    operand shapes, GQA group = local H / local Hkv), so the same
+    dispatch serves both; the guard below catches a mis-sharded pool
+    (lanes that split a head) before it becomes silent garbage."""
     b, h, d = q.shape
     p_total, page_size, khd = k_pages.shape
+    if khd % d:
+        raise ValueError(
+            f"page lanes ({khd}) must hold whole KV heads of head_dim="
+            f"{d} — a TP shard that splits a head mid-lane cannot "
+            "attend (tp must divide num_kv_heads)")
     max_pages = block_tables.shape[1]
     quantized = scale_pages is not None
     if scale is None:
@@ -874,6 +887,10 @@ def _paged_multi_query_ref(q, state, base_len, scale=None):
     """
     b, m, h, d = q.shape
     p_total, page_size, khd = state.k_pages.shape
+    if khd % d:
+        raise ValueError(
+            f"page lanes ({khd}) must hold whole KV heads of head_dim="
+            f"{d} (sharded-pool dispatch: tp must divide num_kv_heads)")
     h_kv = khd // d
     if scale is None:
         scale = 1.0 / math.sqrt(d)

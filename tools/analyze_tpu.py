@@ -593,6 +593,84 @@ def _moe_ep_gspmd():
     return moe_ep_gspmd, [params, x], {"mesh": mesh, "check_processes": 2}
 
 
+def _tp_serving_engine(prefill_chunk=None):
+    """Tiny sharded serving engine at the active sweep mesh size
+    (ISSUE 11): tp=1 builds the plain single-chip program, tp>1 the
+    shard_map program with column/row-sharded weights and a
+    head-sharded page pool — the registry traces whichever the sweep
+    asks for, so `make analyze --mesh 1 --mesh 4 --mesh 8` statically
+    gates the whole comm plan before any multi-device run."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import Engine
+    from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+
+    paddle.seed(0)
+    tp = _mesh_n()
+    cfg = tiny_llama_config(num_heads=8, num_kv_heads=8)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return Engine(model, max_slots=2, num_pages=32, page_size=8,
+                  chunk_size=4, dtype=jnp.float32, max_chain=2,
+                  prefill_chunk=prefill_chunk,
+                  disaggregate=prefill_chunk is not None,
+                  tp=tp if tp > 1 else None)
+
+
+def _tp_sharded_decode_step():
+    """The tensor-parallel decode chain (ISSUE 11 tentpole): weights
+    column/row-sharded, KV pool head-sharded, the whole lax.scan inside
+    ONE shard_map region so page shards carry locally across steps (no
+    TPC502 reshard at the step boundary) and the only collectives are
+    the per-layer Megatron g psums (no TPC503 weight gather)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _tp_serving_engine()
+    nb = 2
+    fn = eng.runner.traceable("decode", sampling=False, k=1)
+    fn.__name__ = "tp_sharded_decode_step"
+    tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+    tables[:, :2] = [[1, 2], [3, 4]]
+    args = [eng._params, eng._pages_flat(), jnp.asarray(tables),
+            jnp.asarray(np.array([9, 6], np.int32)),   # lengths
+            jnp.zeros((nb,), jnp.int32),               # last_tok
+            jnp.zeros((nb,), jnp.float32),             # temps
+            jnp.zeros((nb, 2), jnp.uint32)]            # keys
+    kw = {"donate_argnums": (1,), "check_processes": 2}
+    if eng.runner.mesh is not None:
+        kw["mesh"] = eng.runner.mesh
+    return fn, args, kw
+
+
+def _tp_sharded_mixed_step():
+    """The tensor-parallel mixed chunk+decode step (ISSUE 11): the
+    prefill-role program of the disaggregated scheduler, sharded
+    exactly like the decode chain."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _tp_serving_engine(prefill_chunk=4)
+    nb, chunk = 2, 4
+    fn = eng.runner.traceable("mixed", sampling=False)
+    fn.__name__ = "tp_sharded_mixed_step"
+    tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+    tables[:, :2] = [[1, 2], [3, 4]]
+    ids = np.zeros((nb, chunk), np.int32)
+    args = [eng._params, eng._pages_flat(), jnp.asarray(ids),
+            jnp.asarray(np.array([4, 1], np.int32)),   # widths
+            jnp.asarray(np.array([0, 1], np.int32)),   # emit
+            jnp.asarray(tables),
+            jnp.asarray(np.array([3, 9], np.int32)),   # lengths
+            jnp.zeros((nb,), jnp.float32),             # temps
+            jnp.zeros((nb, 2), jnp.uint32)]            # keys
+    kw = {"donate_argnums": (1,), "check_processes": 2}
+    if eng.runner.mesh is not None:
+        kw["mesh"] = eng.runner.mesh
+    return fn, args, kw
+
+
 ENTRIES: List[Entry] = [
     Entry("llama_decode_step", _llama_decode_step,
           "serving decode: one token through the slab KV cache"),
@@ -629,6 +707,12 @@ ENTRIES: List[Entry] = [
           "fused verify/suffix slab kernel (pallas_call boundary)"),
     Entry("chunked_prefill_step", _chunked_prefill_step,
           "mixed chunk+decode step: chunked prefill + width-1 decode"),
+    Entry("tp_sharded_decode_step", _tp_sharded_decode_step,
+          "TP serving decode chain: sharded weights/pool, per-layer "
+          "g psums (ISSUE 11)", meshable=True),
+    Entry("tp_sharded_mixed_step", _tp_sharded_mixed_step,
+          "TP mixed chunk+decode step: the disaggregated prefill role "
+          "sharded like decode", meshable=True),
 ]
 
 

@@ -60,7 +60,24 @@ _force_virtual_devices()
 def calibrate_host() -> Dict[str, float]:
     """Measured peaks of THIS host, the device profile the prediction
     prices against: dense matmul flops/s, memcpy bytes/s, and the
-    per-collective-step latency of a tiny psum on the live mesh."""
+    collective cost model.
+
+    Calibration rework (ISSUE 11 satellite, ROADMAP item 5 first step):
+    the r10 harness timed ONE tiny psum at the full mesh and divided by
+    its ring steps — folding the fixed per-collective overhead (runtime
+    launch + rendezvous, large on a CPU host) into the per-step slope,
+    which overpriced many-step programs (TP-step pred_vs_measured
+    1.27x). Now the tiny psum is timed at SEVERAL ring sizes, the
+    dispatch floor (an empty shard_map) is subtracted, and a least-
+    squares line over (ring steps, seconds) separates:
+
+    * ``coll_overhead_s`` — the intercept: fixed per-TRANSFER overhead
+      each collective pays once;
+    * ``coll_step_latency_s`` — the slope: the true per-hop latency.
+
+    Both feed ``CommEstimate.seconds_at(bw, lat, per_collective_s)``
+    (the same rollup TPC601 uses), driving the TP-step ratio toward the
+    ≤1.15x target recorded in MULTICHIP_r11.json."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -82,20 +99,40 @@ def calibrate_host() -> Dict[str, float]:
     best = min(_timed(lambda: cp(big).block_until_ready(), 3))
     membw = 2.0 * big.nbytes / best
 
-    # collective step latency: a scalar-ish psum on the mesh; its wire
-    # time is ~0, so step time / ring steps is the per-step latency
     ndev = len(jax.devices())
-    lat = 20e-6
+    lat, overhead, dispatch = 20e-6, 0.0, 0.0
     if ndev > 1:
-        mesh = Mesh(np.array(jax.devices()).reshape(ndev), ("dp",))
         tiny = jnp.ones((8,), jnp.float32)
-        ps = jax.jit(shard_map(lambda x: jax.lax.psum(x, "dp"), mesh,
-                               in_specs=P(), out_specs=P(), check=False))
-        ps(tiny).block_until_ready()
-        best = min(_timed(lambda: ps(tiny).block_until_ready(), 5))
-        lat = best / (2 * (ndev - 1))
+        sizes = sorted({2, max(2, ndev // 2), ndev})
+        pts = []  # (ring steps, collective seconds above dispatch floor)
+        for n in sizes:
+            mesh = Mesh(np.array(jax.devices()[:n]).reshape(n), ("dp",))
+            ps = jax.jit(shard_map(
+                lambda x: jax.lax.psum(x, "dp"), mesh,
+                in_specs=P(), out_specs=P(), check=False))
+            nop = jax.jit(shard_map(
+                lambda x: x + 0.0, mesh,
+                in_specs=P(), out_specs=P(), check=False))
+            ps(tiny).block_until_ready()
+            nop(tiny).block_until_ready()
+            t_ps = sorted(_timed(
+                lambda: ps(tiny).block_until_ready(), 9))[4]
+            t_nop = sorted(_timed(
+                lambda: nop(tiny).block_until_ready(), 9))[4]
+            if n == ndev:
+                dispatch = t_nop
+            pts.append((2.0 * (n - 1), max(0.0, t_ps - t_nop)))
+        xs = np.array([s for s, _ in pts])
+        ys = np.array([t for _, t in pts])
+        if len(pts) >= 2 and float(np.ptp(xs)) > 0:
+            slope, intercept = np.polyfit(xs, ys, 1)
+            lat = float(max(slope, 0.0))
+            overhead = float(max(intercept, 0.0))
+        else:
+            lat = float(ys[-1] / max(xs[-1], 1.0))
     return {"flops_per_s": flops, "mem_bytes_per_s": membw,
-            "coll_step_latency_s": lat}
+            "coll_step_latency_s": lat, "coll_overhead_s": overhead,
+            "dispatch_floor_s": dispatch}
 
 
 def _timed(fn, n: int):
@@ -192,7 +229,8 @@ def tp_step_metrics(n_devices: int, steps: int = 16) -> Dict[str, object]:
                         b / cal["mem_bytes_per_s"])
                     for f, b in cr.by_prim.values())
     comm_s = est.seconds_at(cal["mem_bytes_per_s"],
-                            cal["coll_step_latency_s"])
+                            cal["coll_step_latency_s"],
+                            cal["coll_overhead_s"])
     overlapped = min(comm_s * est.overlap_fraction, compute_s)
     pred_s = compute_s + comm_s - overlapped
     # the drift-tracking prediction swaps the modeled compute term for
@@ -222,6 +260,125 @@ def tp_step_metrics(n_devices: int, steps: int = 16) -> Dict[str, object]:
         "host": "cpu" if jax.default_backend() == "cpu" else
                 jax.devices()[0].device_kind,
     }
+
+
+# ------------------------------------------------------------ tp serving
+
+
+def _tp_serving_engine(tp: int):
+    """A tiny sharded serving engine over the virtual mesh (the ISSUE 11
+    tp_serving bench surface: sharded paged decode + chunked prefill)."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import Engine
+    from paddle_tpu.models.llama import LlamaForCausalLM, tiny_llama_config
+
+    paddle.seed(0)
+    cfg = tiny_llama_config(num_heads=8, num_kv_heads=8, hidden_size=128,
+                            intermediate_size=256)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    return Engine(model, max_slots=4, num_pages=96, page_size=8,
+                  chunk_size=4, dtype=jnp.float32, max_chain=4,
+                  prefill_chunk=8, disaggregate=True,
+                  tp=tp if tp > 1 else None)
+
+
+def tp_serving_metrics(n_devices: int, steps: int = 16
+                       ) -> Dict[str, object]:
+    """Measured-vs-predicted comm for the SHARDED SERVING programs
+    (ISSUE 11 satellite): the tensor-parallel decode chain and the mixed
+    chunk+decode step — the two programs a disaggregated serving step
+    dispatches — each timed warm against a collective-stripped twin
+    (same sharded weights and per-shard compute, psums skipped), with
+    the tpushard comm rollup priced under the host calibration. The
+    combined ``pred_vs_measured`` rides bench.py's existing 2x gate."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.analysis.jaxpr import comm_rollup
+
+    eng = _tp_serving_engine(n_devices)
+    runner = eng.runner
+    cal = calibrate_host()
+    nb = 4
+    rng = np.random.default_rng(0)
+
+    def decode_args():
+        tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+        for i in range(nb):
+            tables[i, :2] = [1 + 2 * i, 2 + 2 * i]
+        return [eng._params, eng._pages_flat(), jnp.asarray(tables),
+                jnp.asarray(np.full((nb,), 9, np.int32)),
+                jnp.asarray(rng.integers(
+                    0, eng.cfg.vocab_size, (nb,)).astype(np.int32)),
+                jnp.zeros((nb,), jnp.float32),
+                jnp.zeros((nb, 2), jnp.uint32)]
+
+    def mixed_args():
+        tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+        for i in range(nb):
+            tables[i, :2] = [1 + 2 * i, 2 + 2 * i]
+        ids = rng.integers(0, eng.cfg.vocab_size,
+                           (nb, eng.prefill_chunk)).astype(np.int32)
+        return [eng._params, eng._pages_flat(), jnp.asarray(ids),
+                jnp.asarray(np.array([8, 1, 8, 1], np.int32)),  # widths
+                jnp.asarray(np.array([0, 1, 0, 1], np.int32)),  # emit
+                jnp.asarray(tables),
+                jnp.asarray(np.array([3, 9, 0, 7], np.int32)),  # lengths
+                jnp.zeros((nb,), jnp.float32),
+                jnp.zeros((nb, 2), jnp.uint32)]
+
+    out: Dict[str, object] = {"n_devices": n_devices,
+                              "schema": "paddle_tpu.tp_serving.v1"}
+    tot_full = tot_pred = 0.0
+    for kind, args_fn, kk in (("decode", decode_args, 2),
+                              ("mixed", mixed_args, 1)):
+        raw = runner.traceable(kind, sampling=False, k=kk)
+        twin_raw = (runner.traceable(kind, sampling=False, k=kk,
+                                     strip_collectives=True)
+                    if runner.sharded else raw)
+        jfull = jax.jit(raw)
+        jtwin = jax.jit(twin_raw)
+
+        def run(fn):
+            res = fn(*args_fn())
+            jax.block_until_ready(res)
+            ts = sorted(_timed(
+                lambda: jax.block_until_ready(fn(*args_fn())), steps))
+            return ts[len(ts) // 2]
+
+        t_full = run(jfull)
+        t_twin = run(jtwin) if runner.sharded else t_full
+        est = comm_rollup(jax.make_jaxpr(raw)(*args_fn()),
+                          mesh=runner.mesh)
+        comm_s = est.seconds_at(cal["mem_bytes_per_s"],
+                                cal["coll_step_latency_s"],
+                                cal["coll_overhead_s"])
+        hybrid = t_twin + comm_s - min(comm_s * est.overlap_fraction,
+                                       t_twin)
+        tot_full += t_full
+        tot_pred += hybrid
+        out[f"{kind}_step_ms"] = round(t_full * 1e3, 4)
+        out[f"{kind}_twin_ms"] = round(t_twin * 1e3, 4)
+        out[f"{kind}_predicted_comm_ms"] = round(comm_s * 1e3, 4)
+        out[f"{kind}_comm_fraction_measured"] = round(
+            max(0.0, 1.0 - t_twin / t_full) if t_full else 0.0, 4)
+        out[f"{kind}_comm_fraction_predicted"] = round(
+            comm_s / hybrid if hybrid else 0.0, 4)
+        out[f"{kind}_n_collectives"] = est.n_collectives
+    out["pred_vs_measured"] = round(
+        tot_pred / tot_full if tot_full else 0.0, 4)
+    out["comm_fraction_measured"] = round(max(
+        out["decode_comm_fraction_measured"],
+        out["mixed_comm_fraction_measured"]), 4)
+    out["comm_fraction_predicted"] = round(max(
+        out["decode_comm_fraction_predicted"],
+        out["mixed_comm_fraction_predicted"]), 4)
+    out["calibration"] = {k: float(f"{v:.6g}") for k, v in cal.items()}
+    return out
 
 
 # ------------------------------------------------------------ suites
@@ -255,9 +412,13 @@ def suite_timings(n_devices: int) -> Dict[str, Dict[str, object]]:
 def multichip_metrics(n_devices: int, tp_only: bool = False
                       ) -> Dict[str, object]:
     payload: Dict[str, object] = {
-        "schema": "paddle_tpu.multichip.v2",
+        "schema": "paddle_tpu.multichip.v3",
         "n_devices": n_devices,
         "tp_step": tp_step_metrics(n_devices),
+        # ISSUE 11: the sharded serving programs (TP decode chain +
+        # mixed chunk step) measured vs their collective-stripped twins
+        # vs the calibrated tpushard prediction
+        "tp_serving": tp_serving_metrics(n_devices),
     }
     if not tp_only:
         payload["suites"] = suite_timings(n_devices)

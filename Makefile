@@ -28,7 +28,13 @@ analyze:
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
 		tests/test_train_resilience.py tests/test_prefix_cache.py \
-		tests/test_chunked_prefill.py tests/test_tp_serving.py -q
+		tests/test_chunked_prefill.py tests/test_tp_serving.py \
+		tests/test_multi_step.py tests/test_api_server.py -q
+
+serve-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python \
+		examples/serve_llama_paged.py --tiny --api-port 0 --api-smoke \
+		--multi-step 2 --tenant-weights "interactive=4,batch=1"
 
 test: lint analyze chaos
 	python -m pytest tests/ -x -q --ignore=tests/onchip
@@ -39,4 +45,4 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: lint analyze chaos test onchip bench
+.PHONY: lint analyze chaos serve-smoke test onchip bench

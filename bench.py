@@ -382,6 +382,20 @@ def bench_prefix(cfg, on_tpu):
         return {"prefix_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_slo(cfg, on_tpu):
+    """Serving-front-end SLO scenario (ISSUE 12): multi-step decode
+    speedup (multi_step=4 >= 1.2x multi_step=1), an open-loop Poisson
+    load sustaining target QPS with p99 TTFT/TPOT under budget, and a
+    tenant-fairness run where a batch flood degrades the interactive
+    tenant's p99 TTFT < 2x."""
+    try:
+        from paddle_tpu.serving.loadgen import bench_slo_serving
+
+        return bench_slo_serving(cfg, on_tpu)
+    except Exception as e:
+        return {"slo_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_resume(on_tpu):
     """Training-resilience scenario (ISSUE 7): amortized per-step
     checkpoint-save overhead through the raw train-step path — sync vs
@@ -597,6 +611,7 @@ def main():
     spec = bench_spec(decode_cfg, on_tpu)
     fault = bench_fault(decode_cfg, on_tpu)
     prefix = bench_prefix(decode_cfg, on_tpu)
+    slo = bench_slo(decode_cfg, on_tpu)
     resume = bench_resume(on_tpu)
     multichip = bench_multichip()
 
@@ -663,6 +678,14 @@ def main():
             metric_total("paddle_tpu_prefill_chunks_total")),
         "slab_verify_dispatches": int(
             metric_total("paddle_tpu_slab_verify_dispatch_total")),
+        # serving front-end surface (ISSUE 12): iterations batched per
+        # host round trip (1.0 mean = the fast path never engaged) and
+        # the SLO block's own gates beside it
+        "steps_per_roundtrip_mean": round(histogram_summary(
+            "paddle_tpu_engine_steps_per_roundtrip").get("mean", 0.0), 3),
+        "multistep_speedup": slo.get("multistep_speedup", 0.0),
+        "slo_p99_ttft_ms": slo.get("slo_p99_ttft_ms", 0.0),
+        "fairness_ttft_degrade": slo.get("fairness_ttft_degrade", 0.0),
         # training-resilience surface (ISSUE 7): checkpoint commits and
         # the in-loop guard counters as the registry saw them
         "train_checkpoints": int(
@@ -712,6 +735,7 @@ def main():
         **spec,
         **fault,
         **prefix,
+        **slo,
         **resume,
         **multichip,
         "metrics": metrics_block,

@@ -932,10 +932,90 @@ class _ModuleAnalyzer:
                           f"outside the branch diverge from the ones "
                           f"inside")
 
+    # -- TPL901: blocking calls inside async defs (serving front-end) ------
+
+    # any call through these module roots blocks (sync sockets,
+    # subprocess waits, urllib fetches, raw http clients)
+    _ASYNC_BLOCKING_ROOTS = {"socket", "subprocess", "urllib", "requests",
+                             "http"}
+    # method tails that block on engine-ish receivers: a direct engine
+    # call from a coroutine races the engine thread AND stalls the loop
+    _ASYNC_ENGINE_TAILS = {"step", "run", "add_request", "cancel"}
+
+    @staticmethod
+    def _walk_outside_nested(scope):
+        """Walk a function body WITHOUT descending into nested function
+        definitions: a nested sync helper is fine per se (it may run in
+        an executor) — only calls the coroutine itself makes block it."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _async_blocking_reason(self, call: ast.Call) -> Optional[str]:
+        fn = call.func
+        dotted = _dotted(fn) or ""
+        root = dotted.split(".")[0] if dotted else None
+        tail = _tail_name(fn)
+        if dotted == "time.sleep" or (
+                isinstance(fn, ast.Name) and fn.id == "sleep"
+                and self.from_imports.get("sleep", "").startswith("time.")):
+            return ("time.sleep in a coroutine stalls every live "
+                    "stream — await asyncio.sleep instead")
+        if isinstance(fn, ast.Name) and fn.id == "open":
+            return ("synchronous open() in a coroutine blocks the "
+                    "event loop — use run_in_executor")
+        if root in self._ASYNC_BLOCKING_ROOTS or (
+                root is not None
+                and (self.import_alias.get(root, "").split(".")[0]
+                     in self._ASYNC_BLOCKING_ROOTS)):
+            return (f"synchronous {root}.* I/O in a coroutine blocks "
+                    "the event loop — use asyncio streams or "
+                    "run_in_executor")
+        if tail == "result" and isinstance(fn, ast.Attribute):
+            # Future.result() is the classic deadlock-in-disguise;
+            # flag receivers that look like futures
+            toks = self._path_expr_tokens(fn.value)
+            if "fut" in toks or "future" in toks:
+                return ("Future.result() in a coroutine blocks the "
+                        "loop — await it (or wrap with wrap_future)")
+        if tail in self._ASYNC_ENGINE_TAILS and isinstance(fn,
+                                                           ast.Attribute):
+            toks = self._path_expr_tokens(fn.value)
+            if "engine" in toks or toks.split() and \
+                    toks.split()[-1] in ("eng", "engine"):
+                return (f"direct Engine.{tail}() from a coroutine: the "
+                        "engine is owned by the frontend thread — go "
+                        "through the ServingFrontend queue/ticket "
+                        "surface (or run_in_executor for drain)")
+        return None
+
+    def _check_async_blocking(self):
+        """TPL901 — serving-front-end modules only (paddle_tpu/serving/):
+        the event loop multiplexes every live SSE stream, so one
+        blocking call in any coroutine stalls them all."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("serving" in p for p in parts):
+            return
+        for scope in ast.walk(self.tree):
+            if not isinstance(scope, ast.AsyncFunctionDef):
+                continue
+            for n in self._walk_outside_nested(scope):
+                if not isinstance(n, ast.Call):
+                    continue
+                reason = self._async_blocking_reason(n)
+                if reason is not None:
+                    self._add(R.ASYNC_BLOCKING_CALL, n, reason)
+
     def _check_module_wide(self):
         self._check_error_handling()
         self._check_ckpt_writes()
         self._check_multihost_divergence()
+        self._check_async_blocking()
         # TPL304: module-bound donating wrappers are callable from any
         # function below, so function scopes inherit the module's set
         module_wrappers = self._collect_donating_wrappers(self.tree)

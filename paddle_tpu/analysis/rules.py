@@ -35,6 +35,11 @@ Families (first digit of the numeric part):
   the commit point). The traced-program sibling is tpucheck's TPC510
   (retrace-under-identities); this family sees the *pattern* in any
   module, TPC510 proves the *consequence* on an entry point.
+* ``9xx`` — async serving: blocking calls inside ``async def`` bodies
+  on the serving front-end (``paddle_tpu/serving/``), where one
+  blocked coroutine stalls EVERY live token stream the event loop is
+  multiplexing (ISSUE 12). Engine calls belong on the frontend's
+  engine thread; anything else blocking belongs in an executor.
 """
 from __future__ import annotations
 
@@ -180,6 +185,21 @@ MULTIHOST_DIVERGENT_GUARD = _rule(
     "race past the commit point and can read a checkpoint that is not "
     "there yet. Add the barrier, or hoist the guarded work out of the "
     "per-process branch.")
+
+
+ASYNC_BLOCKING_CALL = _rule(
+    "TPL901", "async-serving", "blocking-call-in-async-def",
+    "blocking call inside an `async def` in a serving-front-end module "
+    "(paddle_tpu/serving/): time.sleep, a synchronous file open, "
+    "socket/subprocess/urllib I/O, a Future.result(), or a direct "
+    "Engine.step/run/add_request/cancel on an engine object. The API "
+    "server's event loop multiplexes every live SSE stream — one "
+    "blocking call inside a coroutine stalls ALL of them (and a direct "
+    "engine call additionally races the engine thread, which owns the "
+    "non-thread-safe Engine). Await the async equivalent "
+    "(asyncio.sleep, StreamReader/Writer), hand blocking work to "
+    "loop.run_in_executor, or route engine work through the "
+    "ServingFrontend's queue/ticket surface.")
 
 
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -126,6 +126,26 @@ output. TPU-first design instead of a C++ executor loop:
   bit-identical to the single-chip engine in every mode
   (``tests/test_tp_serving.py``); the sharded programs are statically
   gated by tpushard (``make analyze --mesh 1 --mesh 4 --mesh 8``).
+* **Multi-step scheduling (ISSUE 12).** ``Engine(multi_step=N)`` (or an
+  explicit ``step(n=N)``) amortizes the host round trip over N decode
+  iterations: in pure-decode phases (queue empty, spec off, no prompt
+  mid-stream) the scheduler dispatches N chained-decode programs
+  BACK-TO-BACK — each chain's device outputs (pages, lengths, PRNG
+  keys, last token) feed the next with no host fetch between — and
+  harvests all N with ONE blocking ``device_get``. The Orca
+  iteration-level-scheduling move: host work (numpy packing, harvest,
+  metrics, the step spine) is paid once per N iterations instead of
+  per iteration. Token streams are BIT-IDENTICAL to ``multi_step=1``
+  in every mode (greedy, sampled, spec, chunked, disaggregated, TP —
+  ``tests/test_multi_step.py``, ``make chaos``): per-row computation is
+  unchanged, chains compose exactly as sequential steps would, and the
+  harvest walks the chains in order with the same per-request isolation
+  — early-exiting the moment the active set drains (eos/budget/fault),
+  so later chains' rows for finished requests are discarded exactly
+  like chain overshoot. Steps that must consult the host every
+  iteration (admission waves, mixed chunk scheduling, spec drafting)
+  keep classic stepping; ``paddle_tpu_engine_steps_per_roundtrip``
+  records how many iterations each round trip actually batched.
 * **Continuous telemetry (ISSUE 3).** Every scheduling step records the
   vLLM/Orca-style operational surface into the process-global metrics
   registry (``paddle_tpu.observability``): TTFT/TPOT/queue-wait
@@ -182,6 +202,15 @@ def _patch_rows(last_c, keys_c, rows, toks, keys):
     out-of-bounds index and drop. (jit caches per shape by itself.)"""
     return (last_c.at[rows].set(toks, mode="drop"),
             keys_c.at[rows].set(keys, mode="drop"))
+
+
+@jax.jit
+def _last_col(toks):
+    """Final token column of a chain's [nb, steps] output block — the
+    next chain's last-token input in a multi-step round trip (ISSUE 12).
+    Jitted: the eager dynamic-slice dispatch costs ~10x a cached jit
+    call on the hot path (measured ~46% of the multi-step loop)."""
+    return toks[:, -1]
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -259,6 +288,10 @@ class Request:
     on_token: Optional[Callable] = None  # streaming callback(list[int])
     temperature: float = 0.0  # 0 → greedy argmax
     seed: Optional[int] = None  # sampling seed (None → rid)
+    # multi-tenant serving (ISSUE 12): the admission-control/fairness
+    # identity; labels the TTFT/queue-wait/failure metrics (bounded
+    # cardinality — see _EngineMetrics._tenant_label)
+    tenant: str = "default"
     tokens: List[int] = field(default_factory=list)  # generated tokens
     done: bool = False
     slot: Optional[int] = None
@@ -302,15 +335,21 @@ class _EngineMetrics:
     def __init__(self):
         from ..observability import SIZE_BUCKETS, counter, gauge, histogram
 
+        # TTFT/queue-wait/failures carry a ``tenant`` label (ISSUE 12
+        # satellite) so per-tenant SLOs are scrape-visible; engine-direct
+        # traffic lands on the "default" tenant. Cardinality is bounded:
+        # past _TENANT_CAP distinct tenants, new ones share "other".
         self.ttft = histogram(
             "paddle_serving_ttft_seconds",
-            "request arrival to first generated token")
+            "request arrival to first generated token, by tenant",
+            labelnames=("tenant",))
         self.tpot = histogram(
             "paddle_serving_tpot_seconds",
             "mean inter-token latency per harvest (time-per-output-token)")
         self.queue_wait = histogram(
             "paddle_serving_queue_wait_seconds",
-            "request arrival to slot admission")
+            "request arrival to slot admission, by tenant",
+            labelnames=("tenant",))
         self.step_seconds = histogram(
             "paddle_serving_step_seconds",
             "wall time of one scheduling step (dispatch+harvest fence)")
@@ -351,8 +390,8 @@ class _EngineMetrics:
         # error-taxonomy slugs in inference/errors.py one-to-one
         self.failures = counter(
             "paddle_tpu_request_failures_total",
-            "requests moved to terminal FAILED, by taxonomy reason",
-            labelnames=("reason",))
+            "requests moved to terminal FAILED, by taxonomy reason and "
+            "tenant", labelnames=("reason", "tenant"))
         self.admission_rejected = counter(
             "paddle_tpu_admission_rejected_total",
             "requests rejected at add_request (validation, capacity, "
@@ -402,16 +441,56 @@ class _EngineMetrics:
             "multi-query slab-attention programs dispatched, by path "
             "(the fused Pallas kernel on TPU, its jnp twin on CPU)",
             labelnames=("path",))
+        # multi-step scheduling surface (ISSUE 12): how many engine
+        # iterations each host round trip actually batched (1 = classic
+        # stepping; N = the multi-step fast path engaged at depth N)
+        self.steps_per_roundtrip = histogram(
+            "paddle_tpu_engine_steps_per_roundtrip",
+            "engine iterations batched behind one host round trip "
+            "(multi-step scheduling; 1 = classic per-iteration stepping)",
+            buckets=SIZE_BUCKETS)
         # per-depth counter children cached here: .labels() costs a
         # tuple build + dict probe per call, and step() hits one depth
         # every iteration
         self._depth_children: Dict[int, object] = {}
+        # per-tenant histogram/counter children, same rationale; the
+        # seen-set bounds label cardinality (a hostile client cycling
+        # tenant strings must not grow the scrape unboundedly)
+        self._tenant_seen: set = set()
+        self._ttft_children: Dict[str, object] = {}
+        self._qwait_children: Dict[str, object] = {}
+
+    _TENANT_CAP = 24  # distinct tenant label values before "other"
 
     def chain_depth_at(self, k: int):
         child = self._depth_children.get(k)
         if child is None:
             child = self.chain_depth.labels(depth=k)
             self._depth_children[k] = child
+        return child
+
+    def _tenant_label(self, tenant: str) -> str:
+        t = tenant or "default"
+        if t not in self._tenant_seen:
+            if len(self._tenant_seen) >= self._TENANT_CAP:
+                return "other"
+            self._tenant_seen.add(t)
+        return t
+
+    def ttft_for(self, tenant: str):
+        t = self._tenant_label(tenant)
+        child = self._ttft_children.get(t)
+        if child is None:
+            child = self.ttft.labels(tenant=t)
+            self._ttft_children[t] = child
+        return child
+
+    def queue_wait_for(self, tenant: str):
+        t = self._tenant_label(tenant)
+        child = self._qwait_children.get(t)
+        if child is None:
+            child = self.queue_wait.labels(tenant=t)
+            self._qwait_children[t] = child
         return child
 
     def on_harvest(self, req: Request, fresh: int):
@@ -428,7 +507,7 @@ class _EngineMetrics:
         now = time.perf_counter()
         if req._t_first is None:
             req._t_first = now
-            self.ttft.observe(now - req._t_arrival)
+            self.ttft_for(req.tenant).observe(now - req._t_arrival)
             if fresh > 1:
                 # a chained harvest delivers first token + decode tokens
                 # at once; attribute the span evenly to the decode tokens
@@ -452,7 +531,8 @@ class Engine:
                  fault_plan=None, watchdog: Optional[dict] = None,
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
-                 tp: Optional[int] = None, disaggregate: bool = False):
+                 tp: Optional[int] = None, disaggregate: bool = False,
+                 multi_step: int = 1):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -520,6 +600,11 @@ class Engine:
         self._temps = np.zeros((max_slots,), np.float32)
         self._keys = np.zeros((max_slots, 2), np.uint32)
         self._next_rid = 0
+        # multi-step scheduling (ISSUE 12): default iterations batched
+        # per host round trip when step() is called without n; the fast
+        # path only engages where streams provably stay bit-identical
+        # (see _multi_chained_step)
+        self.multi_step = max(1, int(multi_step))
         self._chain_time_ema = {}   # depth k -> EMA step wall seconds
         self._chain_obs = 0          # pure-decode steps observed
         self._probe_budget = 2       # bounded depth-calibration probes
@@ -660,7 +745,8 @@ class Engine:
 
     def add_request(self, prompt, max_new_tokens, on_token=None,
                     temperature=0.0, seed=None,
-                    deadline_s: Optional[float] = None) -> Request:
+                    deadline_s: Optional[float] = None,
+                    tenant: Optional[str] = None) -> Request:
         """Submit a request. EVERY way the request could be unservable is
         checked here, up front (ISSUE 6 satellite): malformed input →
         ``ValidationError``, a sequence the pool/table geometry can never
@@ -722,7 +808,8 @@ class Engine:
                 f"wait queue full ({len(self._queue)}/{self.max_queue}); "
                 "retry later or raise max_queue"))
         req = Request(self._next_rid, prompt, max_new_tokens, on_token,
-                      temperature=float(temperature), seed=seed)
+                      temperature=float(temperature), seed=seed,
+                      tenant=str(tenant) if tenant else "default")
         req._t_arrival = time.perf_counter()
         ttl = deadline_s if deadline_s is not None else self.deadline_s
         if ttl is not None:
@@ -765,7 +852,9 @@ class Engine:
         if self._spec is not None:
             self._spec.controller.forget(req)
         if self._m is not None:
-            self._m.failures.labels(reason=req.failure_reason).inc()
+            self._m.failures.labels(
+                reason=req.failure_reason,
+                tenant=self._m._tenant_label(req.tenant)).inc()
 
     def _expire_deadlines(self):
         """Fail every queued/active request whose deadline/TTL elapsed
@@ -1328,7 +1417,7 @@ class Engine:
         after preemption is preemption cost, already counted there)."""
         if self._m is not None and not req._admitted:
             req._admitted = True
-            self._m.queue_wait.observe(
+            self._m.queue_wait_for(req.tenant).observe(
                 time.perf_counter() - req._t_arrival)
 
     def _prefill_wave(self, rows):
@@ -1988,6 +2077,10 @@ class Engine:
                 if req.done:
                     del self._active[slot]
                     self._free_slot(slot)
+                    # clearing the binding makes the done-and-unbound
+                    # guard above skip this request's rows in any LATER
+                    # chain of a multi-step round trip (ISSUE 12)
+                    req.slot = None
             except RequestError as e:
                 self._fail_request(req, e)
             except Exception as e:
@@ -2086,19 +2179,28 @@ class Engine:
             self._chain_harvest(chain[0], chain[1], toks, lengths_h,
                                 keys_h, bad_h)
 
-    def step(self) -> int:
-        """One scheduling iteration. NEVER raises (ISSUE 6): request-
+    def step(self, n: Optional[int] = None) -> int:
+        """One scheduling round trip. NEVER raises (ISSUE 6): request-
         scoped faults fail the one request (terminal FAILED with a
         taxonomy reason) inside ``_chained_step``/``_spec_step``'s
         per-request isolation blocks; anything that escapes them is an
         engine-scoped fault handled by ``_recover_step_fault`` —
         requeue-all recompute + pool reset + watchdog degradation.
+
+        ``n`` (default ``Engine(multi_step=)``) is the multi-step budget
+        (ISSUE 12): in pure-decode phases up to ``n`` decode iterations
+        dispatch back-to-back and harvest behind ONE blocking fetch;
+        phases that need per-iteration host decisions (admission waves,
+        mixed chunk scheduling, spec drafting) run exactly one iteration
+        regardless. Token streams are bit-identical for every ``n``.
         Returns the number of live requests remaining (queued + active)."""
         t0 = time.perf_counter()
         if self._fi is not None and self._fi.fire("slow-step"):
             time.sleep(self._fi.param("slow-step", "delay_ms", 20.0) / 1e3)
         if self._has_deadlines:
             self._expire_deadlines()
+        budget = self.multi_step if n is None else max(1, int(n))
+        batched = 1
         try:
             if self._wants_mixed():
                 if self.disaggregate:
@@ -2107,12 +2209,15 @@ class Engine:
                     self._mixed_step()
             elif self._spec is not None and self._spec_enabled:
                 self._spec_step()
+            elif budget > 1 and self._active and not self._queue:
+                batched = self._multi_chained_step(budget)
             else:
                 self._chained_step(t0)
             self._watchdog.note_step_ok()
         except Exception as e:
             self._recover_step_fault(e)
         if self._m is not None:
+            self._m.steps_per_roundtrip.observe(batched)
             self._m.step_seconds.observe(time.perf_counter() - t0)
             self._m.active_slots.set(len(self._active))
             self._m.queue_depth.set(len(self._queue))
@@ -2285,6 +2390,119 @@ class Engine:
                 # for the measured dispatch-cost ratio (a fresh compile's
                 # trace/cache-load seconds would poison the fit)
                 self._observe_chain_time(nb, k, time.perf_counter() - t0)
+
+    def _multi_chained_step(self, budget: int) -> int:
+        """Multi-step scheduling fast path (ISSUE 12 tentpole): up to
+        ``budget`` chained-decode iterations per host round trip.
+
+        Engages only from ``step()`` when the round is PURE DECODE —
+        active slots, empty queue, spec off, no prompt mid-chunk — the
+        phase where every iteration would otherwise pay the full host
+        round trip (pack, dispatch, fetch, harvest) for identical
+        scheduling decisions. The same compiled (bucket, depth) decode
+        program dispatches ``budget`` times back-to-back with its device
+        outputs (pages, lengths, keys, last token) feeding the next
+        dispatch — no host fetch between — and ONE ``device_get`` fence
+        harvests every chain in submission order.
+
+        Bit-identical to sequential ``step()`` calls by construction:
+
+        * per-row computation is the untouched decode program; chaining
+          N dispatches computes exactly what N sequential steps compute
+          (the host fetch/re-upload between steps is value-preserving);
+        * the harvest walks chains in order through ``_chain_harvest``'s
+          per-request isolation blocks — eos/budget truncation, NaN
+          guards, and fault-injection points fire per request per chain
+          exactly as they do per step;
+        * a request finishing (or failing) at chain i frees its slot
+          there; its rows in chains i+1.. are garbage the harvest guards
+          skip — the same discard path as chain overshoot, with writes
+          confined to pages the slot owned (released on free);
+        * once the active set drains the harvest EARLY-EXITS, discarding
+          the remaining chains wholesale.
+
+        Page reservation covers all ``budget`` chains up front; under
+        pool pressure the budget halves BEFORE the shrink→preempt→fail
+        ladder can evict anyone a single step wouldn't have (and even a
+        preemption keeps streams identical — recompute policy). Returns
+        the number of iterations actually harvested."""
+        self._stall_steps = 0
+        k = self._chain_depth()
+        # cap the budget at the work that exists: chains past every
+        # request's remaining budget would be pure garbage compute
+        max_rem = max(req.max_new_tokens - len(req.tokens)
+                      for req in self._active.values())
+        budget = max(1, min(budget, -(-max_rem // (k * self.chunk_size))))
+
+        def need_for(b):
+            tot = 0
+            for slot, req in self._active.items():
+                have = int(np.count_nonzero(self.tables[slot]))
+                want = min(int(self.lengths[slot]) + b * k * self.chunk_size,
+                           req.prompt.size + req.max_new_tokens + 1)
+                tot += max(0, self._pages_needed(want) - have)
+            return tot
+
+        while budget > 1 and need_for(budget) > self._available_pages():
+            budget //= 2
+        k = self._reserve_step_pages(
+            k, lambda slot, req, kk: min(
+                int(self.lengths[slot]) + kk * budget * self.chunk_size,
+                req.prompt.size + req.max_new_tokens + 1))
+        if not self._active:
+            return 1
+        k = max(1, k)
+        slots = sorted(self._active)
+        slot_reqs = [self._active[s] for s in slots]
+        n = len(slots)
+        nb = _pow2ceil(n)
+        if self._m is not None:
+            self._m.decode_batch.observe(n)
+        tables_c = np.zeros((nb, self.max_pages_per_seq), np.int32)
+        lengths_c = np.zeros((nb,), np.int32)
+        last_c = np.zeros((nb,), np.int32)
+        temps_c = np.zeros((nb,), np.float32)
+        keys_c = np.zeros((nb, 2), np.uint32)
+        tables_c[:n] = self.tables[slots]
+        lengths_c[:n] = self.lengths[slots]
+        last_c[:n] = self._last_tok[slots]
+        temps_c[:n] = self._temps[slots]
+        keys_c[:n] = self._keys[slots]
+        sampling = bool(np.any(temps_c > 0.0))
+        decode = self._get_decode(nb, k, sampling)
+        tables_j = jnp.asarray(tables_c)
+        temps_j = jnp.asarray(temps_c)
+        pages = self._pages_flat()
+        lengths_in = jnp.asarray(lengths_c)
+        last_in = jnp.asarray(last_c)
+        keys_in = jnp.asarray(keys_c)
+        chains = []
+        for _ in range(budget):
+            toks_d, pages, lengths_in, keys_in, bad_d = decode(
+                self._params, pages, tables_j, lengths_in, last_in,
+                temps_j, keys_in)
+            # the chain-to-chain handoff stays ON DEVICE: the next
+            # chain's last-token input is the previous chain's final
+            # column (statically gated by the analyze registry's
+            # multi_step_decode twin at tp>1 — shards carry locally)
+            last_in = _last_col(toks_d)
+            chains.append((toks_d, lengths_in, keys_in, bad_d))
+            if self._m is not None:
+                self._m.chain_depth_at(k).inc()
+        self._set_pages(pages)
+        # ---- the round trip's ONLY blocking fence ----
+        fetched = jax.device_get(tuple(h for c in chains for h in c))
+        done = 0
+        for i in range(budget):
+            toks, lengths_h, keys_h, bad_h = (
+                np.asarray(a) for a in fetched[4 * i:4 * i + 4])
+            self._chain_harvest(slots, slot_reqs, toks, lengths_h,
+                                keys_h, bad_h)
+            done = i + 1
+            if not self._active:
+                break  # early exit: everyone finished/failed — the
+                # remaining chains' outputs are overshoot, discarded
+        return done
 
     # ------------------------------------------------ speculative decoding
     def _spec_step(self):

@@ -1,0 +1,33 @@
+"""paddle_tpu.serving — the async streaming front-end (ISSUE 12).
+
+The layer that turns the paged ``inference.Engine`` into a *service*:
+
+* :mod:`fairness` — the weighted-fair multi-tenant request queue
+  (stride scheduling with per-tenant admission bounds) that sits in
+  front of the engine-core scheduler, so one tenant's 32k-token batch
+  flood cannot starve interactive traffic.
+* :mod:`frontend` — ``ServingFrontend``: the engine-core loop on its
+  own thread (every ``Engine`` call lives there — the engine is not
+  thread-safe), multi-step scheduling when the queue is idle, stream
+  tickets bridging harvest callbacks to any consumer (blocking
+  iterators, asyncio queues), and the graceful SIGTERM drain.
+* :mod:`server` — ``ApiServer``: an OpenAI-compatible streaming HTTP
+  server (pure stdlib asyncio; SSE ``/v1/completions`` +
+  ``/v1/chat/completions``) decoupled from the engine by the fair
+  queue. tpulint rule TPL901 enforces that nothing inside this
+  package's ``async def`` bodies blocks the event loop.
+* :mod:`loadgen` — closed- and open-loop SLO load generation driving
+  the frontend; ``bench_slo`` gates p99 TTFT/TPOT at a target QPS and
+  the multi-step speedup (bench.py's ``slo_*``/``multistep_*`` keys).
+
+The package itself is stdlib+numpy; only the frontend's engine thread
+ever touches jax/compiled programs — the event loop and the fair queue
+never do (tpulint TPL901 keeps it that way).
+"""
+from .fairness import DEFAULT_TENANT, FairQueue, parse_tenant_weights
+from .frontend import ServingFrontend, StreamTicket
+
+__all__ = [
+    "DEFAULT_TENANT", "FairQueue", "parse_tenant_weights",
+    "ServingFrontend", "StreamTicket",
+]

@@ -1,0 +1,360 @@
+"""The serving front-end's engine-core loop (ISSUE 12 tentpole).
+
+``ServingFrontend`` decouples request arrival from the scheduling loop:
+
+* **One engine thread.** The paged ``Engine`` is not thread-safe, so
+  EVERY engine call (``add_request``/``step``/``cancel``) happens on the
+  frontend's dedicated thread. Submitters only touch the thread-safe
+  :class:`~paddle_tpu.serving.fairness.FairQueue` and their own
+  :class:`StreamTicket`; the loop drains the queue into the engine,
+  steps it, and completes tickets.
+* **Fair admission with concurrency shares.** The loop feeds the engine
+  only while it can place work NOW (free slots beyond the engine's own
+  short queue), popping by weighted virtual time and skipping tenants
+  already holding their weight-proportional slot share while other
+  tenants wait — work-conserving: with no contention any tenant may use
+  every slot. This is what bounds a batch tenant's starvation of
+  interactive traffic (the ISSUE 12 fairness gate).
+* **Multi-step when idle.** With arrivals queued the loop steps the
+  engine one iteration at a time (fast turnover — a freed slot admits
+  the next fair pick immediately); with the queue idle it hands the
+  engine its full ``multi_step`` budget and the pure-decode fast path
+  amortizes the host round trip (``Engine.step(n)``).
+* **Graceful drain (SIGTERM).** ``drain(grace_s)`` — PR 7's preemption
+  pattern applied to serving — stops admissions (``QueueFull`` to new
+  submitters), lets in-flight streams finish inside the grace budget,
+  then cancels the stragglers through the engine's taxonomy ``cancel``
+  path so every stream terminates cleanly (finish or ``cancelled``),
+  and finally stops the engine thread.
+
+``StreamTicket`` is the submitter's handle: a thread-safe token stream
+(blocking ``next_chunk``/``result`` for sync consumers, an ``on_chunk``
+callback for asyncio bridging — the HTTP server passes one that trampolines
+into its event loop) plus host-side TTFT/TPOT timestamps the SLO load
+generator reads.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..inference.errors import EngineError, QueueFull
+from .fairness import DEFAULT_TENANT, FairQueue
+
+__all__ = ["ServingFrontend", "StreamTicket"]
+
+
+class StreamTicket:
+    """A submitted request's stream handle. Engine-thread side pushes
+    token chunks and the terminal state; any thread consumes."""
+
+    def __init__(self, prompt, max_new_tokens: int, temperature: float,
+                 seed: Optional[int], tenant: str,
+                 deadline_s: Optional[float],
+                 on_chunk: Optional[Callable] = None):
+        self.prompt = np.asarray(prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = seed
+        self.tenant = tenant
+        self.deadline_s = deadline_s
+        self.rid: Optional[int] = None
+        self.tokens: List[int] = []
+        self.done = False
+        self.failure_reason: Optional[str] = None
+        self.cancelled = False
+        # host-side latency marks (the SLO loadgen's measurement side)
+        self.t_submit = time.perf_counter()
+        self.t_first: Optional[float] = None
+        self.t_done: Optional[float] = None
+        self._chunks: deque = deque()
+        self._cond = threading.Condition()
+        self._on_chunk = on_chunk
+
+    # ------------------------------------------- engine-thread callbacks
+    def _on_tokens(self, toks: List[int]):
+        now = time.perf_counter()
+        with self._cond:
+            if self.t_first is None:
+                self.t_first = now
+            self.tokens.extend(int(t) for t in toks)
+            self._chunks.append(list(toks))
+            self._cond.notify_all()
+        if self._on_chunk is not None:
+            self._on_chunk(list(toks))
+
+    def _finish(self, failure_reason: Optional[str] = None):
+        with self._cond:
+            if self.done:
+                return
+            self.done = True
+            self.failure_reason = failure_reason
+            self.t_done = time.perf_counter()
+            self._cond.notify_all()
+        if self._on_chunk is not None:
+            self._on_chunk(None)  # end-of-stream sentinel
+
+    # --------------------------------------------------- consumer surface
+    def next_chunk(self, timeout: Optional[float] = None):
+        """Block for the next token chunk; None marks end of stream."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self._chunks and not self.done:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                if left == 0.0 or not self._cond.wait(left):
+                    raise TimeoutError("no chunk within timeout")
+            if self._chunks:
+                return self._chunks.popleft()
+            return None
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the stream terminates; returns all tokens (check
+        ``failure_reason`` for how it ended)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while not self.done:
+                left = (None if deadline is None
+                        else max(0.0, deadline - time.monotonic()))
+                if left == 0.0 or not self._cond.wait(left):
+                    raise TimeoutError("stream did not terminate in time")
+            return list(self.tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return (None if self.t_first is None
+                else self.t_first - self.t_submit)
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean inter-token latency over the decode tail."""
+        if self.t_first is None or self.t_done is None \
+                or len(self.tokens) <= 1:
+            return None
+        return (self.t_done - self.t_first) / (len(self.tokens) - 1)
+
+
+class ServingFrontend:
+    """Engine-core loop thread + fair admission; see module docstring."""
+
+    def __init__(self, engine, tenant_weights: Optional[Dict[str, float]]
+                 = None, max_queue_per_tenant: int = 256,
+                 max_tenants: int = 64, idle_wait_s: float = 0.02):
+        self.engine = engine
+        self.queue = FairQueue(weights=tenant_weights,
+                               max_queue_per_tenant=max_queue_per_tenant,
+                               max_tenants=max_tenants)
+        self._weights = dict(tenant_weights or {})
+        self._idle_wait_s = float(idle_wait_s)
+        self._live: Dict[int, StreamTicket] = {}  # rid -> ticket
+        self._reqs: Dict[int, object] = {}        # rid -> engine Request
+        self._cancels: deque = deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._drained = threading.Event()
+        self._draining = False
+        self._force_cancel = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ control
+    def start(self) -> "ServingFrontend":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-engine-core", daemon=True)
+            self._thread.start()
+        return self
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               seed: Optional[int] = None, tenant: Optional[str] = None,
+               deadline_s: Optional[float] = None,
+               on_chunk: Optional[Callable] = None) -> StreamTicket:
+        """Enqueue a request (any thread). Raises the taxonomy
+        ``QueueFull`` on backpressure or while draining."""
+        if self._draining or self._stop.is_set():
+            raise QueueFull("server is draining; not accepting requests")
+        tenant = tenant or DEFAULT_TENANT
+        ticket = StreamTicket(prompt, max_new_tokens, temperature, seed,
+                              tenant, deadline_s, on_chunk=on_chunk)
+        # token footprint as fairness cost: a 32k-token prompt charges
+        # its tenant's virtual clock accordingly
+        cost = float(ticket.prompt.size + ticket.max_new_tokens)
+        ticket.tenant = self.queue.submit(ticket, tenant=tenant, cost=cost)
+        self._wake.set()
+        return ticket
+
+    def cancel(self, ticket: StreamTicket):
+        """Cancel a stream (any thread): a queued ticket dies in the
+        fair queue; an admitted one goes through ``Engine.cancel`` on
+        the engine thread — slot and pages recycle immediately."""
+        ticket.cancelled = True
+        self._cancels.append(ticket)
+        self._wake.set()
+
+    def drain(self, grace_s: float = 30.0) -> bool:
+        """Graceful shutdown: refuse new work, finish in-flight streams
+        within ``grace_s``, cancel stragglers cleanly, stop the engine
+        thread. Blocking (call off the event loop); True if every
+        stream finished without a forced cancel."""
+        self._draining = True
+        self._wake.set()
+        finished = self._drained.wait(timeout=max(0.0, grace_s))
+        if not finished:
+            self._force_cancel = True
+            self._wake.set()
+            self._drained.wait(timeout=10.0)
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+        return finished
+
+    def shutdown(self):
+        """Immediate stop (tests): cancel everything, join the thread."""
+        if not self._draining:
+            self._draining = True
+            self._force_cancel = True
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+    # ----------------------------------------------------- engine thread
+    def _slot_share(self, tenant: str, contenders: List[str]) -> int:
+        """Weight-proportional slot share for ``tenant`` among the
+        tenants currently contending (queued or holding slots)."""
+        total = sum(self.queue.weight_of(t) for t in contenders) or 1.0
+        w = self.queue.weight_of(tenant)
+        return max(1, int(round(self.engine.max_slots * w / total)))
+
+    def _contenders(self) -> List[str]:
+        live_tenants = {t.tenant for t in self._live.values()}
+        return sorted(live_tenants | set(self.queue.queued_tenants()))
+
+    def _feed(self):
+        """Admit from the fair queue while the engine can place work NOW
+        — free slots beyond its own (short) wait queue.
+
+        Concurrency shares: with an explicit tenant-weight map the
+        shares are HARD — every configured tenant counts as a contender
+        whether or not it has work queued right now, so a batch tenant
+        caps at its weight-proportional slot count and the interactive
+        tenant's slots stay warm between its arrivals (the weights ARE
+        the reservation; a tenant that wants work-conserving behavior
+        gets it by not being weighted). Without a weight map the share
+        check only binds under live contention (fully work-conserving
+        single-tenant/equal-weight behavior)."""
+        eng = self.engine
+        while len(eng._free_slots) > len(eng._queue):
+            if self._weights:
+                contenders = sorted(set(self._weights)
+                                    | {t.tenant
+                                       for t in self._live.values()}
+                                    | set(self.queue.queued_tenants()))
+            else:
+                contenders = self._contenders()
+            blocked = []
+            if len(contenders) > 1:
+                held: Dict[str, int] = {}
+                for t in self._live.values():
+                    held[t.tenant] = held.get(t.tenant, 0) + 1
+                blocked = [t for t in contenders
+                           if held.get(t, 0)
+                           >= self._slot_share(t, contenders)]
+            popped = self.queue.pop(blocked=blocked)
+            if popped is None and blocked and not self._weights:
+                popped = self.queue.pop()  # work-conserving fallback
+            if popped is None:
+                break
+            ticket, tenant = popped
+            ticket.tenant = tenant
+            if ticket.cancelled:
+                ticket._finish("cancelled")
+                continue
+            try:
+                req = eng.add_request(
+                    ticket.prompt, ticket.max_new_tokens,
+                    on_token=ticket._on_tokens,
+                    temperature=ticket.temperature, seed=ticket.seed,
+                    deadline_s=ticket.deadline_s, tenant=tenant)
+            except EngineError as e:
+                ticket._finish(getattr(e, "reason", "engine"))
+                continue
+            except ValueError:
+                ticket._finish("validation")
+                continue
+            ticket.rid = req.rid
+            self._live[req.rid] = ticket
+            self._reqs[req.rid] = req
+
+    def _apply_cancels(self):
+        while self._cancels:
+            ticket = self._cancels.popleft()
+            if ticket.done:
+                continue
+            if ticket.rid is not None:
+                self.engine.cancel(ticket.rid)
+            elif self.queue.remove(ticket):
+                ticket._finish("cancelled")
+            # else: between pop and add_request — the cancelled flag in
+            # _feed catches it
+
+    def _complete(self):
+        """Finish tickets whose engine request reached a terminal
+        state (the engine has no completion callback — harvest only
+        streams tokens)."""
+        if not self._live:
+            return
+        done_rids = []
+        for rid, ticket in self._live.items():
+            req = self._reqs.get(rid)
+            if req is None or req.done:
+                done_rids.append(rid)
+                ticket._finish(req.failure_reason if req is not None
+                               else "engine")
+        for rid in done_rids:
+            self._live.pop(rid, None)
+            self._reqs.pop(rid, None)
+
+    def _loop(self):
+        eng = self.engine
+        try:
+            while not self._stop.is_set():
+                self._apply_cancels()
+                if self._force_cancel:
+                    for rid in list(self._live):
+                        eng.cancel(rid)
+                    while True:
+                        popped = self.queue.pop()
+                        if popped is None:
+                            break
+                        popped[0]._finish("cancelled")
+                # draining still FEEDS: a ticket accepted into the fair
+                # queue is in-flight work the drain must finish (submit
+                # is what the drain gate refuses)
+                self._feed()
+                if eng._queue or eng._active:
+                    # arrivals waiting → single iterations for fast slot
+                    # turnover; idle queue → the multi-step fast path
+                    n = 1 if len(self.queue) else None
+                    eng.step(n)
+                    self._complete()
+                    continue
+                self._complete()
+                if self._draining and not self._live \
+                        and not len(self.queue):
+                    self._drained.set()
+                    if self._stop.is_set():
+                        break
+                # idle: sleep until a submit/cancel/drain wakes us
+                self._wake.wait(timeout=self._idle_wait_s)
+                self._wake.clear()
+        finally:
+            self._drained.set()

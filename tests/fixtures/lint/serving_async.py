@@ -1,0 +1,79 @@
+"""TPL901 fixtures — blocking calls inside ``async def`` bodies on the
+serving front-end (the path filter keys on 'serving' in the path, which
+this fixture's filename satisfies). The API server's event loop
+multiplexes every live SSE stream: one blocking call in any coroutine
+stalls all of them, and a direct engine call additionally races the
+engine thread that owns the non-thread-safe Engine. Compliant code
+awaits asyncio equivalents, hands blocking work to run_in_executor, or
+routes engine work through the ServingFrontend ticket surface."""
+import asyncio
+import socket
+import subprocess
+import time
+from time import sleep
+
+from some_serving_lib import engine, frontend, loop  # fixture stub
+
+
+async def bad_time_sleep():
+    time.sleep(0.5)  # EXPECT: TPL901
+
+
+async def bad_from_import_sleep():
+    sleep(0.5)  # EXPECT: TPL901
+
+
+async def bad_sync_open(path):
+    with open(path, "w") as f:  # EXPECT: TPL901
+        f.write("x")
+
+
+async def bad_socket_io(host):
+    conn = socket.create_connection((host, 80))  # EXPECT: TPL901
+    return conn
+
+
+async def bad_subprocess_wait(cmd):
+    return subprocess.run(cmd)  # EXPECT: TPL901
+
+
+async def bad_engine_step_direct():
+    # the engine belongs to the frontend thread — a coroutine calling
+    # it races that thread AND blocks the loop for the whole dispatch
+    engine.step()  # EXPECT: TPL901
+
+
+async def bad_future_result(fut):
+    return fut.result()  # EXPECT: TPL901
+
+
+async def suppressed_sleep_for_test_harness():
+    # tpulint: disable=TPL901 -- fixture: deliberate block, test-only
+    time.sleep(0.01)  # EXPECT-SUPPRESSED: TPL901
+
+
+async def good_asyncio_sleep():
+    await asyncio.sleep(0.5)
+
+
+async def good_executor_offload(path):
+    def read_it():
+        # sync helpers are fine per se — this one runs in the executor
+        with open(path) as f:
+            return f.read()
+
+    return await loop.run_in_executor(None, read_it)
+
+
+async def good_frontend_surface(prompt):
+    # engine work goes through the thread-safe ticket surface; the
+    # submit call only enqueues
+    ticket = frontend.submit(prompt, 16)
+    return ticket
+
+
+def good_sync_context():
+    # not a coroutine: the engine loop thread is ALLOWED to block —
+    # that is its whole job
+    time.sleep(0.01)
+    return engine.step()

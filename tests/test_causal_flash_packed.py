@@ -82,6 +82,7 @@ class TestPackedKernel:
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
                                    atol=2e-5)
 
+    @pytest.mark.slow  # tier-1 wall budget; still runs under make test
     def test_tiled_pair_packed_long_seq(self, rng):
         """Pair-packed (hpb=2) layout through the tiled kernels at
         S=2048: forward + backward vs the per-head reference."""
@@ -145,6 +146,7 @@ class TestPackedKernel:
 class TestPackedInModel:
     @pytest.mark.parametrize("hidden,heads", [(128, 2),   # hpb=2 pairing
                                               (192, 3)])  # hpb=1 (odd heads)
+    @pytest.mark.slow  # tier-1 wall budget; still runs under make test
     def test_gpt_train_step_equivalence(self, rng, hidden, heads):
         """Forcing the packed path must not change loss or grads vs the
         general kernel path (twin equivalence at f32)."""

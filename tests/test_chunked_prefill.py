@@ -99,6 +99,8 @@ class TestChunkedIdentity:
         assert tokens(serve(eng)) == clean
         assert_pages_conserved(eng)
 
+    # slow: tier-1 wall budget; chaos-enforced (make chaos runs unfiltered)
+    @pytest.mark.slow
     def test_sampled_identical(self, gpt):
         """temperature>0: the emit gate burns exactly one draw per
         delivered token, so sampled streams match chunked on vs off."""
@@ -144,6 +146,8 @@ class TestChunkedPressure:
         assert tokens(reqs) == clean
         assert_pages_conserved(eng)
 
+    # slow: tier-1 wall budget; chaos-enforced (make chaos runs unfiltered)
+    @pytest.mark.slow
     def test_preemption_mid_prefill_sampled(self, gpt):
         """Sampled + pressure: a preempted mid-prefill request must not
         have burned any draws (emit gate), so its resumed stream matches

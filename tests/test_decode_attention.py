@@ -202,6 +202,7 @@ class TestFusedMultiTransformer:
 
 
 class TestGPTGenerate:
+    @pytest.mark.slow  # tier-1 wall budget; still runs under make test
     def test_greedy_cache_matches_no_cache(self, rng):
         from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
 

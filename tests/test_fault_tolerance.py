@@ -471,8 +471,9 @@ class TestScrapeVisibility:
         eng.run()
         assert req.failure_reason == "nan_logits"
         text = render_prometheus()
-        assert 'paddle_tpu_request_failures_total{reason="nan_logits"}' \
-            in text
+        # failures carry reason AND tenant labels (ISSUE 12 satellite)
+        assert ('paddle_tpu_request_failures_total'
+                '{reason="nan_logits",tenant="default"}') in text
         assert "paddle_tpu_admission_rejected_total" in text
         assert "paddle_tpu_request_retries_total" in text
         assert "paddle_tpu_engine_recoveries_total" in text

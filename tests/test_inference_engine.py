@@ -166,6 +166,8 @@ class TestEngine:
                             max_new_tokens=10, temperature=0.0)
         np.testing.assert_array_equal(r.tokens, np.asarray(want)[0, p.size:])
 
+    # slow: tier-1 wall budget; still runs under make test
+    @pytest.mark.slow
     def test_sampled_resume_after_preemption(self, gpt, rng):
         """Preemption must resume a SAMPLED request exactly: the live PRNG
         key travels with the request, so recompute-preemption reproduces
@@ -309,6 +311,8 @@ class TestInt4Weights:
 
 
 class TestPreAdmission:
+    # slow: tier-1 wall budget; still runs under make test
+    @pytest.mark.slow
     def test_turnover_prefills_in_chain_shadow(self, gpt, rng):
         """With 2x-slots queued greedy requests (no eos), completions are
         predictable and queue heads pre-admit during the freeing chain —
@@ -362,6 +366,8 @@ class TestPreAdmission:
             np.testing.assert_array_equal(
                 r.tokens, np.asarray(want)[0, p.size:])
 
+    # slow: tier-1 wall budget; still runs under make test
+    @pytest.mark.slow
     def test_sampled_preadmission_deterministic(self, gpt, rng):
         """A sampled request pre-admitted mid-serve must produce the same
         tokens as when served alone with the same seed."""

@@ -74,6 +74,8 @@ class TestDataLoader:
 
 
 class TestVision:
+    # slow: zoo build cost, tier-1 wall budget; still runs under make test
+    @pytest.mark.slow
     def test_resnet18_forward_backward(self, rng):
         net = paddle.vision.models.resnet18(num_classes=10)
         x = paddle.to_tensor(rng.standard_normal((2, 3, 32, 32)).astype(np.float32))
@@ -88,6 +90,8 @@ class TestVision:
         x = paddle.to_tensor(rng.standard_normal((2, 1, 28, 28)).astype(np.float32))
         assert net(x).shape == [2, 10]
 
+    # slow: zoo build cost, tier-1 wall budget; still runs under make test
+    @pytest.mark.slow
     def test_mobilenet_builds(self, rng):
         net = paddle.vision.models.mobilenet_v2(num_classes=4)
         x = paddle.to_tensor(rng.standard_normal((1, 3, 32, 32)).astype(np.float32))
@@ -220,6 +224,8 @@ class TestVisionZooAdditions:
         out = net(x)
         assert tuple(out.shape) == (2, 10)
 
+    # slow: zoo build cost, tier-1 wall budget; still runs under make test
+    @pytest.mark.slow
     def test_densenet_tiny(self, rng):
         from paddle_tpu.vision.models import DenseNet
 

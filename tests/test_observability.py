@@ -421,7 +421,10 @@ class TestServeExampleScrape:
                 text = r.read().decode()
             # TTFT + TPOT histograms, with samples
             assert "# TYPE paddle_serving_ttft_seconds histogram" in text
-            assert "paddle_serving_ttft_seconds_count 6" in text
+            # TTFT carries the tenant label (ISSUE 12 satellite);
+            # engine-direct traffic lands on the default tenant
+            assert 'paddle_serving_ttft_seconds_count{tenant="default"} 6' \
+                in text
             assert "# TYPE paddle_serving_tpot_seconds histogram" in text
             assert 'paddle_serving_tpot_seconds_bucket{le="+Inf"}' in text
             # page-pool occupancy gauges

@@ -113,6 +113,7 @@ def test_microbatch_activations_sharded_over_dp(fleet_dp4_pp2):
         assert shard[1] == 8 // 4, (shard, s)
 
 
+@pytest.mark.slow  # tier-1 wall budget; still runs under make test
 def test_per_device_flops_scale_with_dp(fleet_dp4_pp2):
     eng = _train_once(fleet_dp4_pp2, batch=16)
     (key, step), = eng._step_cache.items()

@@ -109,6 +109,7 @@ class TestScheduleTables:
 
 
 class TestInterleaveTwin:
+    @pytest.mark.slow  # tier-1 wall budget; still runs under make test
     def test_pp2_v2_matches_sequential_training(self, rng):
         strategy = DistributedStrategy()
         strategy.hybrid_configs = {"pp_degree": 2, "mp_degree": 1}

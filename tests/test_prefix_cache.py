@@ -179,6 +179,8 @@ class TestSpliceIdentity:
         assert metric_total("paddle_tpu_prefix_cached_prefill_tokens_total") > 0
         assert_conserved(eng)
 
+    # slow: tier-1 wall budget; chaos-enforced (make chaos runs unfiltered)
+    @pytest.mark.slow
     def test_cache_on_matches_cache_off_sampled(self, gpt):
         off = serve_twice(make_engine(gpt, cache=False), temp=0.7)
         eng = make_engine(gpt)

@@ -12,6 +12,7 @@ from paddle_tpu.jit import buffer_arrays, functional_call, param_arrays
 from paddle_tpu.framework.tensor import Tensor
 
 
+@pytest.mark.slow  # tier-1 wall budget; still runs under make test
 def test_resnet_tiny_jitted_step_with_bn_buffers(rng):
     """Config-1 slice: conv net with BatchNorm trains as ONE jit program;
     running stats are threaded functionally through the step."""

@@ -671,6 +671,44 @@ def _tp_sharded_mixed_step():
     return fn, args, kw
 
 
+def _multi_step_decode():
+    """The multi-step scheduling handoff (ISSUE 12): two decode-chain
+    programs composed back-to-back the way ``Engine.step(n)``'s fast
+    path dispatches them — the second chain's inputs are the first's
+    device outputs (pages, lengths, keys, final token column), with no
+    host fetch between. The composed twin statically gates the chain-
+    to-chain boundary at tp>1: page shards must carry locally between
+    the two shard_map regions (no TPC502 reshard) and the only
+    collectives stay the per-layer Megatron g psums (no TPC503)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    eng = _tp_serving_engine()
+    nb = 2
+    chain = eng.runner.traceable("decode", sampling=False, k=1)
+
+    def multi_step_decode(params, pages_flat, tables, lengths, last,
+                          temps, keys):
+        toks1, pages_flat, lengths, keys, bad1 = chain(
+            params, pages_flat, tables, lengths, last, temps, keys)
+        toks2, pages_flat, lengths, keys, bad2 = chain(
+            params, pages_flat, tables, lengths, toks1[:, -1], temps,
+            keys)
+        return toks1, toks2, pages_flat, lengths, keys, bad1 | bad2
+
+    tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+    tables[:, :2] = [[1, 2], [3, 4]]
+    args = [eng._params, eng._pages_flat(), jnp.asarray(tables),
+            jnp.asarray(np.array([9, 6], np.int32)),   # lengths
+            jnp.zeros((nb,), jnp.int32),               # last_tok
+            jnp.zeros((nb,), jnp.float32),             # temps
+            jnp.zeros((nb, 2), jnp.uint32)]            # keys
+    kw = {"donate_argnums": (1,), "check_processes": 2}
+    if eng.runner.mesh is not None:
+        kw["mesh"] = eng.runner.mesh
+    return multi_step_decode, args, kw
+
+
 ENTRIES: List[Entry] = [
     Entry("llama_decode_step", _llama_decode_step,
           "serving decode: one token through the slab KV cache"),
@@ -713,6 +751,9 @@ ENTRIES: List[Entry] = [
     Entry("tp_sharded_mixed_step", _tp_sharded_mixed_step,
           "TP mixed chunk+decode step: the disaggregated prefill role "
           "sharded like decode", meshable=True),
+    Entry("multi_step_decode", _multi_step_decode,
+          "multi-step scheduling: two decode chains composed device-"
+          "side, one harvest fence (ISSUE 12)", meshable=True),
 ]
 
 

@@ -396,6 +396,20 @@ def bench_slo(cfg, on_tpu):
         return {"slo_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_failover(cfg, on_tpu):
+    """Multi-replica failover scenario (ISSUE 13): open-loop load over
+    a 2-replica router with one injected replica kill — every stream
+    completes (migrated, not failed) and the p99 TTFT of unaffected
+    requests degrades < 2x vs a no-kill baseline (interleaved rep
+    pairs, jitter-floored on the single-core smoke host)."""
+    try:
+        from paddle_tpu.serving.loadgen import bench_failover_serving
+
+        return bench_failover_serving(cfg, on_tpu)
+    except Exception as e:
+        return {"failover_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_resume(on_tpu):
     """Training-resilience scenario (ISSUE 7): amortized per-step
     checkpoint-save overhead through the raw train-step path — sync vs
@@ -612,6 +626,7 @@ def main():
     fault = bench_fault(decode_cfg, on_tpu)
     prefix = bench_prefix(decode_cfg, on_tpu)
     slo = bench_slo(decode_cfg, on_tpu)
+    failover = bench_failover(decode_cfg, on_tpu)
     resume = bench_resume(on_tpu)
     multichip = bench_multichip()
 
@@ -686,6 +701,19 @@ def main():
         "multistep_speedup": slo.get("multistep_speedup", 0.0),
         "slo_p99_ttft_ms": slo.get("slo_p99_ttft_ms", 0.0),
         "fairness_ttft_degrade": slo.get("fairness_ttft_degrade", 0.0),
+        # multi-replica failover surface (ISSUE 13): streams migrated
+        # across replica deaths and supervised restarts, as the router's
+        # counters saw them, beside the failover block's own gate
+        "paddle_tpu_router_migrations_total": int(
+            metric_total("paddle_tpu_router_migrations_total")),
+        "paddle_tpu_replica_restarts_total": int(
+            metric_total("paddle_tpu_replica_restarts_total")),
+        "router_hedges": int(
+            metric_total("paddle_tpu_router_hedges_total")),
+        "slow_client_cancels": int(
+            metric_total("paddle_tpu_slow_client_cancels_total")),
+        "failover_ttft_degrade": failover.get(
+            "failover_ttft_degrade", 0.0),
         # training-resilience surface (ISSUE 7): checkpoint commits and
         # the in-loop guard counters as the registry saw them
         "train_checkpoints": int(
@@ -736,6 +764,7 @@ def main():
         **fault,
         **prefix,
         **slo,
+        **failover,
         **resume,
         **multichip,
         "metrics": metrics_block,

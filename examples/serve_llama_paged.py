@@ -46,7 +46,9 @@ def run_api_server(eng, args):
     from paddle_tpu.serving.server import ApiServer
 
     frontend = ServingFrontend(
-        eng, tenant_weights=parse_tenant_weights(args.tenant_weights))
+        eng, tenant_weights=parse_tenant_weights(args.tenant_weights),
+        stream_stall_s=(args.stream_stall_ms / 1e3
+                        if args.stream_stall_ms is not None else None))
     server = ApiServer(frontend, port=args.api_port,
                        model_name="llama-paged",
                        grace_s=args.drain_grace)
@@ -244,6 +246,13 @@ def main():
                          "service, so a batch flood cannot starve "
                          "interactive traffic; unlisted tenants share "
                          "the default weight")
+    ap.add_argument("--stream-stall-ms", type=float, default=None,
+                    help="slow-client watchdog (ISSUE 13): a streaming "
+                         "consumer that stops draining chunks for this "
+                         "many ms (or backlogs past the per-stream "
+                         "buffer bound) is cancelled and its slot/"
+                         "pages freed — an abandoned-but-connected "
+                         "client cannot pin a slot. Off by default")
     ap.add_argument("--drain-grace", type=float, default=30.0,
                     help="SIGTERM drain budget (seconds): in-flight "
                          "streams get this long to finish before being "

@@ -1011,11 +1011,85 @@ class _ModuleAnalyzer:
                 if reason is not None:
                     self._add(R.ASYNC_BLOCKING_CALL, n, reason)
 
+    # -- TPL902: unbounded retry loops (serving resilience) ----------------
+
+    @staticmethod
+    def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+        """A handler that can absorb the exception and reach the next
+        iteration retries the loop. Exits hidden under an `if` (a
+        conditional `raise`) still leave a fall-through retry path, so
+        only an UNCONDITIONAL tail exit (the handler's last top-level
+        statement is raise/break/return) counts as not-swallowing."""
+        if not handler.body:
+            return True
+        return not isinstance(handler.body[-1],
+                              (ast.Raise, ast.Break, ast.Return))
+
+    def _loop_has_retry_handler(self, loop: ast.While) -> bool:
+        for n in self._walk_outside_nested(loop):
+            if isinstance(n, ast.Try):
+                if any(self._handler_swallows(h) for h in n.handlers):
+                    return True
+        return False
+
+    def _loop_has_attempt_bound(self, loop: ast.While) -> bool:
+        """A comparison-guarded exit: `if <compare>: break/raise`
+        anywhere in the loop body — the attempt counter's escape
+        hatch."""
+        for n in self._walk_outside_nested(loop):
+            if not isinstance(n, ast.If):
+                continue
+            has_cmp = any(isinstance(t, ast.Compare)
+                          for t in ast.walk(n.test))
+            if not has_cmp:
+                continue
+            for stmt in ast.walk(n):
+                if isinstance(stmt, (ast.Break, ast.Raise)):
+                    return True
+        return False
+
+    def _loop_has_backoff(self, loop: ast.While) -> bool:
+        for n in self._walk_outside_nested(loop):
+            if not isinstance(n, ast.Call):
+                continue
+            dotted = (_dotted(n.func) or "").lower()
+            tail = (_tail_name(n.func) or "").lower()
+            if tail in ("sleep", "wait") or "backoff" in dotted:
+                return True
+        return False
+
+    def _check_retry_loops(self):
+        """TPL902 — serving modules only: a constant-true `while` whose
+        body swallows an exception and loops is a retry loop; it needs
+        BOTH an attempt bound and a backoff (see the rule text)."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("serving" in p for p in parts):
+            return
+        for loop in ast.walk(self.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            test = loop.test
+            if not (isinstance(test, ast.Constant) and bool(test.value)):
+                continue  # a real condition IS the loop's bound
+            if not self._loop_has_retry_handler(loop):
+                continue
+            missing = []
+            if not self._loop_has_attempt_bound(loop):
+                missing.append("an attempt bound "
+                               "(comparison-guarded break/raise)")
+            if not self._loop_has_backoff(loop):
+                missing.append("a backoff (sleep/wait between attempts)")
+            if missing:
+                self._add(R.UNBOUNDED_RETRY_LOOP, loop,
+                          "retry loop (`while True` swallowing an "
+                          "exception) without " + " or ".join(missing))
+
     def _check_module_wide(self):
         self._check_error_handling()
         self._check_ckpt_writes()
         self._check_multihost_divergence()
         self._check_async_blocking()
+        self._check_retry_loops()
         # TPL304: module-bound donating wrappers are callable from any
         # function below, so function scopes inherit the module's set
         module_wrappers = self._collect_donating_wrappers(self.tree)

@@ -202,4 +202,20 @@ ASYNC_BLOCKING_CALL = _rule(
     "ServingFrontend's queue/ticket surface.")
 
 
+UNBOUNDED_RETRY_LOOP = _rule(
+    "TPL902", "serving-resilience", "unbounded-retry-loop",
+    "a `while True:` loop in a serving module (paddle_tpu/serving/) "
+    "whose body swallows an exception and loops again — a retry loop — "
+    "without BOTH an attempt bound (a comparison-guarded break/raise, "
+    "e.g. `if attempt >= max_attempts: raise`) and a backoff (a "
+    "sleep/wait/backoff call in the loop). The failover layer "
+    "(ISSUE 13) retries placements, migrations and restarts; an "
+    "unbounded or un-backed-off retry turns one dead replica into a "
+    "hot spin that starves the survivors (and, against a remote "
+    "endpoint, a self-inflicted retry storm). Bound the attempts, "
+    "sleep between them, and fail attributably (the taxonomy "
+    "`replica_lost` / `retries_exhausted` reasons) when the bound is "
+    "hit.")
+
+
 FAMILIES = sorted({r.family for r in RULES.values()})

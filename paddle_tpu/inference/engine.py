@@ -194,6 +194,24 @@ from .watchdog import Watchdog
 
 
 @jax.jit
+def _advance_sample_key(key, burns):
+    """Replay ``burns`` sampling-key splits host-free (one fori_loop
+    dispatch, ``burns`` a traced scalar so every count shares one
+    compiled program). The vanilla decode/prefill paths burn EXACTLY one
+    ``jax.random.split`` per DELIVERED token for a temp>0 request (see
+    ``_select_token``: ``new_keys = splits[:, 0]``; chunked prefill is
+    emit-gated the same way), so a stream migrated to another replica
+    with only (prompt, emitted tokens, seed) in hand can reconstruct its
+    live key state as ``split^t(seed_key)[0]`` — the resume-from-emitted
+    admission path (ISSUE 13). Spec decode burns a fixed k+2 keys per
+    VERIFY STEP instead (step count is not recoverable from the token
+    count), which is why ``add_request`` rejects sampled resumes on a
+    spec-enabled engine."""
+    return jax.lax.fori_loop(
+        0, burns, lambda _, k: jax.random.split(k, 2)[0], key)
+
+
+@jax.jit
 def _patch_rows(last_c, keys_c, rows, toks, keys):
     """Splice a prefill wave's first tokens and PRNG keys into the decode
     chain's compacted inputs ON DEVICE — the glue that lets freshly
@@ -406,6 +424,14 @@ class _EngineMetrics:
             "paddle_tpu_engine_degraded",
             "degraded-mode level: 0 healthy, 1 spec decode disabled, "
             "2 admission cap halved on top")
+        # readiness export (ISSUE 13): the /readyz surface and the
+        # router's health gate read this — 1 while the watchdog judges
+        # the engine fit for NEW traffic (level < SMALL_BATCH)
+        self.ready = gauge(
+            "paddle_tpu_engine_ready",
+            "watchdog readiness: 1 = accepting new traffic, 0 = "
+            "degraded past the readiness threshold (in-flight work "
+            "still completes)")
         # prefix-cache surface (ISSUE 8): admission hit/miss, the cached-
         # vs-computed prefill-token split, pressure evictions, and the
         # pool share the cache currently holds
@@ -746,13 +772,28 @@ class Engine:
     def add_request(self, prompt, max_new_tokens, on_token=None,
                     temperature=0.0, seed=None,
                     deadline_s: Optional[float] = None,
-                    tenant: Optional[str] = None) -> Request:
+                    tenant: Optional[str] = None,
+                    resume_tokens=None) -> Request:
         """Submit a request. EVERY way the request could be unservable is
         checked here, up front (ISSUE 6 satellite): malformed input →
         ``ValidationError``, a sequence the pool/table geometry can never
         hold → ``AdmissionRejected``, bounded-queue backpressure →
         ``QueueFull``. Nothing about a single request can fail mid-step
-        for a reason that was knowable at submission."""
+        for a reason that was knowable at submission.
+
+        ``resume_tokens`` (ISSUE 13) is the resume-from-emitted admission
+        path for replica failover: tokens this stream ALREADY emitted on
+        a replica that died. They count against ``max_new_tokens`` but
+        are never re-delivered through ``on_token`` — admission
+        re-prefills prompt‖emitted (the preemption machinery's
+        ``_prefix``, with the prefix cache absorbing the recompute) and
+        generation continues bit-identically where the dead replica
+        stopped. Seeded-sampled streams reconstruct their key state by
+        replaying one key split per emitted token
+        (``_advance_sample_key``); that replay is exact for the vanilla
+        and chunked paths but not under spec decode (fixed k+2 burns per
+        verify STEP), so a sampled resume on a spec-enabled engine is
+        rejected up front rather than silently diverging."""
         raw = np.asarray(prompt)
         if raw.dtype.kind not in "iu":
             self._reject(ValidationError(
@@ -807,9 +848,55 @@ class Engine:
             self._reject(QueueFull(
                 f"wait queue full ({len(self._queue)}/{self.max_queue}); "
                 "retry later or raise max_queue"))
+        resumed: List[int] = []
+        if resume_tokens is not None and len(resume_tokens):
+            raw_r = np.asarray(resume_tokens)
+            if raw_r.dtype.kind not in "iu":
+                self._reject(ValidationError(
+                    f"resume_tokens must be integer token ids, got dtype "
+                    f"{raw_r.dtype}"))
+            resumed = [int(t) for t in raw_r.reshape(-1)]
+            if min(resumed) < 0 or max(resumed) >= self.cfg.vocab_size:
+                self._reject(ValidationError(
+                    f"resume_tokens must lie in [0, {self.cfg.vocab_size})"))
+            if len(resumed) >= int(max_new_tokens):
+                self._reject(ValidationError(
+                    f"resume_tokens ({len(resumed)}) already meet the "
+                    f"generation budget ({max_new_tokens}) — the stream "
+                    "is complete, nothing to resume"))
+            if self.eos_id is not None and self.eos_id in resumed:
+                self._reject(ValidationError(
+                    "resume_tokens contain eos — the stream already "
+                    "terminated on its source replica"))
+            if float(temperature) > 0.0 and self._spec is not None:
+                self._reject(ValidationError(
+                    "sampled resume on a spec-enabled engine: spec "
+                    "decode burns keys per verify step, not per token, "
+                    "so the migrated key state cannot be reconstructed "
+                    "from the emitted-token count — resume on a "
+                    "spec=off replica (greedy resumes are exact either "
+                    "way)"))
+            if float(temperature) > 0.0 and seed is None:
+                self._reject(ValidationError(
+                    "sampled resume needs an explicit seed: the source "
+                    "replica's implicit per-rid seed does not transfer "
+                    "across engines"))
         req = Request(self._next_rid, prompt, max_new_tokens, on_token,
                       temperature=float(temperature), seed=seed,
                       tenant=str(tenant) if tenant else "default")
+        if resumed:
+            # pre-populate emitted history: _prefix() re-prefills
+            # prompt‖emitted exactly like a preemption re-admission, and
+            # _harvest appends (and delivers) only FRESH tokens
+            req.tokens = resumed
+            if float(temperature) > 0.0:
+                seed_v = int(seed if seed is not None else req.rid)
+                key0 = np.array(
+                    [(seed_v >> 32) & 0xFFFFFFFF, seed_v & 0xFFFFFFFF],
+                    np.uint32)
+                req._key = np.asarray(jax.device_get(_advance_sample_key(
+                    jnp.asarray(key0), jnp.int32(len(resumed)))),
+                    np.uint32)
         req._t_arrival = time.perf_counter()
         ttl = deadline_s if deadline_s is not None else self.deadline_s
         if ttl is not None:

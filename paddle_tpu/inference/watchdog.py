@@ -120,6 +120,25 @@ class Watchdog:
         self._accept.clear()
         self._apply()
 
+    # ------------------------------------------------------- readiness
+    @property
+    def ready(self) -> bool:
+        """Readiness for NEW traffic (ISSUE 13): liveness is the
+        process/thread being up (the supervisor's job, not ours);
+        readiness is this state machine judging the engine fit to take
+        MORE work. NO_SPEC still serves at full admission capacity
+        (drafting off costs throughput, not correctness), so it stays
+        ready; SMALL_BATCH means the engine is actively shedding load —
+        a router should stop sending it new streams and let it recover
+        while in-flight work completes."""
+        return self.level < SMALL_BATCH
+
+    def readiness(self) -> dict:
+        """The structured readiness snapshot ``/readyz`` and the
+        multi-replica router consume."""
+        return {"ready": self.ready, "level": self.level,
+                "mode": self.mode}
+
     def _apply(self):
         eng = self.engine
         eng._spec_enabled = self.level < NO_SPEC
@@ -138,6 +157,7 @@ class Watchdog:
         eng._slot_cap = cap
         if eng._m is not None:
             eng._m.degraded.set(self.level)
+            eng._m.ready.set(1 if self.ready else 0)
 
     @property
     def mode(self) -> str:

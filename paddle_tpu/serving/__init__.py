@@ -19,15 +19,26 @@ The layer that turns the paged ``inference.Engine`` into a *service*:
 * :mod:`loadgen` — closed- and open-loop SLO load generation driving
   the frontend; ``bench_slo`` gates p99 TTFT/TPOT at a target QPS and
   the multi-step speedup (bench.py's ``slo_*``/``multistep_*`` keys).
+* :mod:`replica` / :mod:`router` — the replica-resilience layer
+  (ISSUE 13): supervised engine replicas (in-process or subprocess
+  workers behind the ApiServer protocol) with split liveness/readiness,
+  health-gated routing, and KV-free mid-stream request migration —
+  a dead replica's streams re-admit elsewhere as prompt‖emitted and
+  the client sees one uninterrupted, bit-identical token sequence.
 
 The package itself is stdlib+numpy; only the frontend's engine thread
 ever touches jax/compiled programs — the event loop and the fair queue
-never do (tpulint TPL901 keeps it that way).
+never do (tpulint TPL901 keeps it that way; TPL902 additionally bans
+unbounded retry loops anywhere in this package).
 """
 from .fairness import DEFAULT_TENANT, FairQueue, parse_tenant_weights
 from .frontend import ServingFrontend, StreamTicket
+from .replica import InProcReplica, Replica, StreamSpec, SubprocessReplica
+from .router import Router, RouterTicket
 
 __all__ = [
     "DEFAULT_TENANT", "FairQueue", "parse_tenant_weights",
     "ServingFrontend", "StreamTicket",
+    "Replica", "InProcReplica", "SubprocessReplica", "StreamSpec",
+    "Router", "RouterTicket",
 ]

@@ -55,18 +55,26 @@ class StreamTicket:
     def __init__(self, prompt, max_new_tokens: int, temperature: float,
                  seed: Optional[int], tenant: str,
                  deadline_s: Optional[float],
-                 on_chunk: Optional[Callable] = None):
+                 on_chunk: Optional[Callable] = None,
+                 resume_tokens: Optional[List[int]] = None,
+                 max_buffered: int = 4096):
         self.prompt = np.asarray(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
         self.seed = seed
         self.tenant = tenant
         self.deadline_s = deadline_s
+        # resume-from-emitted (ISSUE 13): tokens the stream already
+        # delivered elsewhere; passed through to Engine.add_request —
+        # only FRESH tokens ever reach this ticket's consumer
+        self.resume_tokens = (list(resume_tokens)
+                              if resume_tokens else None)
         self.rid: Optional[int] = None
         self.tokens: List[int] = []
         self.done = False
         self.failure_reason: Optional[str] = None
         self.cancelled = False
+        self.stall_cancelled = False
         # host-side latency marks (the SLO loadgen's measurement side)
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
@@ -74,6 +82,17 @@ class StreamTicket:
         self._chunks: deque = deque()
         self._cond = threading.Condition()
         self._on_chunk = on_chunk
+        # slow-client accounting (ISSUE 13 satellite): chunks handed to
+        # the consumer side but not yet consumed. Pull consumers ack by
+        # popping (next_chunk); push bridges (the SSE writer) call
+        # ``ack()`` once the bytes actually drained to the client. A
+        # consumer that stops consuming while the engine keeps emitting
+        # shows up as pending > 0 with a growing stall clock — the
+        # frontend cancels it, freeing the slot and pages an abandoned-
+        # but-connected client would otherwise pin forever.
+        self.max_buffered = int(max_buffered)
+        self._pending = 0
+        self._t_oldest: Optional[float] = None
 
     # ------------------------------------------- engine-thread callbacks
     def _on_tokens(self, toks: List[int]):
@@ -82,7 +101,13 @@ class StreamTicket:
             if self.t_first is None:
                 self.t_first = now
             self.tokens.extend(int(t) for t in toks)
-            self._chunks.append(list(toks))
+            if self._on_chunk is None:
+                # pull surface only: a push bridge would double-buffer
+                # every chunk here with no consumer to drain it
+                self._chunks.append(list(toks))
+            if self._pending == 0:
+                self._t_oldest = now
+            self._pending += 1
             self._cond.notify_all()
         if self._on_chunk is not None:
             self._on_chunk(list(toks))
@@ -99,6 +124,28 @@ class StreamTicket:
             self._on_chunk(None)  # end-of-stream sentinel
 
     # --------------------------------------------------- consumer surface
+    def ack(self, n: int = 1):
+        """Consumer-side progress mark (slow-client watchdog): a push
+        bridge calls this after it actually delivered a chunk (e.g. the
+        SSE writer after ``drain()``); pull consumers ack implicitly by
+        popping. Keeps the stall clock honest for consumers the engine
+        cannot see."""
+        now = time.perf_counter()
+        with self._cond:
+            self._pending = max(0, self._pending - int(n))
+            self._t_oldest = now if self._pending else None
+
+    def stalled_for(self, now: Optional[float] = None) -> float:
+        """Seconds the oldest unconsumed chunk has been waiting (0.0
+        when the consumer is keeping up). A backlog past
+        ``max_buffered`` reports inf — the bounded-buffer trip wire."""
+        with self._cond:
+            if self._pending <= 0 or self._t_oldest is None:
+                return 0.0
+            if self._pending > self.max_buffered:
+                return float("inf")
+            return (now or time.perf_counter()) - self._t_oldest
+
     def next_chunk(self, timeout: Optional[float] = None):
         """Block for the next token chunk; None marks end of stream."""
         deadline = None if timeout is None else time.monotonic() + timeout
@@ -109,6 +156,9 @@ class StreamTicket:
                 if left == 0.0 or not self._cond.wait(left):
                     raise TimeoutError("no chunk within timeout")
             if self._chunks:
+                self._pending = max(0, self._pending - 1)
+                self._t_oldest = (time.perf_counter() if self._pending
+                                  else None)
                 return self._chunks.popleft()
             return None
 
@@ -143,13 +193,32 @@ class ServingFrontend:
 
     def __init__(self, engine, tenant_weights: Optional[Dict[str, float]]
                  = None, max_queue_per_tenant: int = 256,
-                 max_tenants: int = 64, idle_wait_s: float = 0.02):
+                 max_tenants: int = 64, idle_wait_s: float = 0.02,
+                 stream_stall_s: Optional[float] = None,
+                 max_buffered_chunks: int = 4096,
+                 ready_queue_depth: Optional[int] = None):
         self.engine = engine
         self.queue = FairQueue(weights=tenant_weights,
                                max_queue_per_tenant=max_queue_per_tenant,
                                max_tenants=max_tenants)
         self._weights = dict(tenant_weights or {})
         self._idle_wait_s = float(idle_wait_s)
+        # slow-client policy (ISSUE 13 satellite): a live ticket whose
+        # consumer has not made progress for stream_stall_s (or whose
+        # unconsumed backlog passed max_buffered_chunks) is cancelled
+        # through the engine's taxonomy path — slot and pages free
+        # immediately instead of being pinned by an abandoned-but-
+        # connected client. None disables the timer (pull consumers that
+        # only ever call result() never ack); the buffer bound always
+        # holds.
+        self.stream_stall_s = (None if stream_stall_s is None
+                               else float(stream_stall_s))
+        self.max_buffered_chunks = int(max_buffered_chunks)
+        # readiness gate (ISSUE 13): queued work beyond this depth marks
+        # the replica not-ready so a router sends new streams elsewhere
+        self.ready_queue_depth = int(
+            ready_queue_depth if ready_queue_depth is not None
+            else max(8, 4 * engine.max_slots))
         self._live: Dict[int, StreamTicket] = {}  # rid -> ticket
         self._reqs: Dict[int, object] = {}        # rid -> engine Request
         self._cancels: deque = deque()
@@ -158,6 +227,7 @@ class ServingFrontend:
         self._drained = threading.Event()
         self._draining = False
         self._force_cancel = False
+        self._poisoned = False
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------ control
@@ -172,17 +242,60 @@ class ServingFrontend:
     def draining(self) -> bool:
         return self._draining
 
+    @property
+    def alive(self) -> bool:
+        """Liveness: the engine thread is up and not poisoned. This is
+        the multi-replica supervisor's process-up check for in-process
+        replicas."""
+        return (self._thread is not None and self._thread.is_alive()
+                and not self._poisoned)
+
+    def readiness(self) -> Dict:
+        """Readiness snapshot (ISSUE 13): the ``/readyz`` payload and
+        the router's health gate. Ready = alive, not draining, the
+        engine watchdog below its readiness threshold, and the combined
+        queue depth under ``ready_queue_depth``. All fields are host
+        ints read without the engine lock — a racy read is at worst one
+        scheduling step stale, which is exactly the staleness any
+        health probe has."""
+        eng = self.engine
+        wd = eng._watchdog.readiness()
+        queued = len(self.queue) + len(eng._queue)
+        ready = (self.alive and not self._draining and wd["ready"]
+                 and queued <= self.ready_queue_depth)
+        return {"ready": bool(ready), "alive": self.alive,
+                "draining": self._draining,
+                "watchdog_level": wd["level"],
+                "watchdog_mode": wd["mode"], "queue_depth": queued,
+                "active": len(eng._active),
+                "inflight": len(self._live) + queued}
+
+    def poison(self):
+        """Simulate sudden replica death (the chaos surface behind the
+        ``replica-crash`` fault point for in-process replicas): the
+        engine thread exits at its next loop turn WITHOUT finishing,
+        cancelling, or draining anything — live tickets simply go
+        silent, exactly like a SIGKILLed process's streams. The router's
+        stall watchdog / liveness probe is what must notice."""
+        self._poisoned = True
+        self._wake.set()
+
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
                seed: Optional[int] = None, tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
-               on_chunk: Optional[Callable] = None) -> StreamTicket:
+               on_chunk: Optional[Callable] = None,
+               resume_tokens: Optional[List[int]] = None) -> StreamTicket:
         """Enqueue a request (any thread). Raises the taxonomy
-        ``QueueFull`` on backpressure or while draining."""
-        if self._draining or self._stop.is_set():
+        ``QueueFull`` on backpressure or while draining.
+        ``resume_tokens`` is the replica-migration resume path — see
+        ``Engine.add_request``."""
+        if self._draining or self._stop.is_set() or self._poisoned:
             raise QueueFull("server is draining; not accepting requests")
         tenant = tenant or DEFAULT_TENANT
         ticket = StreamTicket(prompt, max_new_tokens, temperature, seed,
-                              tenant, deadline_s, on_chunk=on_chunk)
+                              tenant, deadline_s, on_chunk=on_chunk,
+                              resume_tokens=resume_tokens,
+                              max_buffered=self.max_buffered_chunks)
         # token footprint as fairness cost: a 32k-token prompt charges
         # its tenant's virtual clock accordingly
         cost = float(ticket.prompt.size + ticket.max_new_tokens)
@@ -283,7 +396,8 @@ class ServingFrontend:
                     ticket.prompt, ticket.max_new_tokens,
                     on_token=ticket._on_tokens,
                     temperature=ticket.temperature, seed=ticket.seed,
-                    deadline_s=ticket.deadline_s, tenant=tenant)
+                    deadline_s=ticket.deadline_s, tenant=tenant,
+                    resume_tokens=ticket.resume_tokens)
             except EngineError as e:
                 ticket._finish(getattr(e, "reason", "engine"))
                 continue
@@ -306,6 +420,34 @@ class ServingFrontend:
             # else: between pop and add_request — the cancelled flag in
             # _feed catches it
 
+    def _cancel_stalled(self):
+        """Slow-client watchdog (ISSUE 13 satellite): cancel live
+        tickets whose consumer stopped making progress — stalled past
+        ``stream_stall_s``, or backlogged past ``max_buffered_chunks``
+        (``stalled_for`` reports inf for those regardless of the
+        timer). Cancellation rides the engine's taxonomy path, so the
+        slot and pages recycle immediately."""
+        if not self._live:
+            return
+        now = time.perf_counter()
+        for rid, ticket in list(self._live.items()):
+            stalled = ticket.stalled_for(now)
+            over = (self.stream_stall_s is not None
+                    and stalled > self.stream_stall_s)
+            if not over and stalled != float("inf"):
+                continue
+            ticket.stall_cancelled = True
+            self.engine.cancel(rid)
+            try:
+                from ..observability import counter
+
+                counter("paddle_tpu_slow_client_cancels_total",
+                        "streams cancelled because the consumer "
+                        "stalled past the stream-stall budget or the "
+                        "per-stream chunk buffer bound").inc()
+            except Exception:  # pragma: no cover - stdlib-only contexts
+                pass
+
     def _complete(self):
         """Finish tickets whose engine request reached a terminal
         state (the engine has no completion callback — harvest only
@@ -327,7 +469,13 @@ class ServingFrontend:
         eng = self.engine
         try:
             while not self._stop.is_set():
+                if self._poisoned:
+                    # sudden-death chaos surface: vanish mid-flight.
+                    # Live tickets stay unfinished on purpose — the
+                    # router's failover machinery is what must react.
+                    return
                 self._apply_cancels()
+                self._cancel_stalled()
                 if self._force_cancel:
                     for rid in list(self._live):
                         eng.cancel(rid)

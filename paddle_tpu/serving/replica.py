@@ -1,0 +1,440 @@
+"""Supervised engine replicas for the multi-replica serving layer
+(ISSUE 13 tentpole).
+
+A *replica* is one serving engine behind a uniform lifecycle + stream
+surface the :mod:`router <paddle_tpu.serving.router>` can supervise:
+
+* :class:`InProcReplica` — an ``Engine`` + ``ServingFrontend`` pair in
+  this process (built by a caller-supplied factory so a restart gets a
+  FRESH engine). Liveness is the frontend's engine thread; sudden death
+  is ``ServingFrontend.poison()`` (the ``replica-crash`` fault point's
+  in-process arm: the thread vanishes without finishing its tickets,
+  exactly like a SIGKILLed process's streams going silent).
+* :class:`SubprocessReplica` — a worker process speaking the
+  :class:`~paddle_tpu.serving.server.ApiServer` protocol (e.g.
+  ``examples/serve_llama_paged.py --api-port 0``). Liveness is the
+  process being up; readiness is its ``/readyz``; streams ride SSE on a
+  per-stream reader thread; ``kill()`` is a real SIGKILL.
+
+The split health surface both implement (ISSUE 13):
+
+* **liveness** (``alive``) — the process/thread exists. Only a dead
+  replica gets restarted.
+* **readiness** (``ready()``) — fit for NEW traffic: not draining,
+  engine watchdog below its degradation threshold, queue depth in
+  bounds. The router health-gates routing on this; a live-but-unready
+  replica keeps its in-flight streams and takes no new ones.
+* **heartbeat** (``heartbeat(plan)``) — the supervisor's periodic
+  probe; the ``heartbeat-drop`` fault point (keyed by replica index via
+  the plan's ``rid`` selector) makes it report failure while the
+  replica stays up, driving the router's false-positive arm.
+
+Every stream callback is invoked from replica-owned threads (engine
+thread or SSE reader); the router's handlers do their own locking.
+This module has no ``async def`` — all blocking I/O here runs on
+dedicated threads, never an event loop (tpulint TPL901 guards that).
+"""
+from __future__ import annotations
+
+import http.client
+import json
+import signal
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Replica", "InProcReplica", "SubprocessReplica",
+           "StreamSpec", "ReplicaStream"]
+
+
+class StreamSpec:
+    """The replica-agnostic description of one stream: everything needed
+    to (re)submit it anywhere, including the resume-from-emitted state."""
+
+    __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
+                 "tenant", "deadline_s", "resume_tokens")
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int,
+                 temperature: float = 0.0, seed: Optional[int] = None,
+                 tenant: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 resume_tokens: Optional[List[int]] = None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.seed = seed
+        self.tenant = tenant
+        self.deadline_s = deadline_s
+        self.resume_tokens = list(resume_tokens) if resume_tokens else None
+
+
+class ReplicaStream:
+    """One in-flight stream on one replica. The router owns the
+    callbacks; ``cancel()`` tears the upstream down without firing
+    ``on_broken`` (a cancelled stream is not a crashed one)."""
+
+    def __init__(self, replica: "Replica", spec: StreamSpec,
+                 on_chunk: Callable, on_done: Callable,
+                 on_broken: Callable):
+        self.replica = replica
+        self.spec = spec
+        self.on_chunk = on_chunk      # (stream, list[int])
+        self.on_done = on_done        # (stream, failure_reason|None)
+        self.on_broken = on_broken    # (stream, exc)
+        self.cancelled = False
+        self.closed = False
+        self._impl = None  # replica-specific handle
+
+    def cancel(self):
+        self.cancelled = True
+        self.replica._cancel(self)
+
+
+class Replica:
+    """Base lifecycle/stream surface; see module docstring."""
+
+    def __init__(self, name: str, index: int = 0):
+        self.name = name
+        self.index = int(index)
+        self.restarts = 0
+        self._streams: set = set()
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ health
+    def alive(self) -> bool:
+        raise NotImplementedError
+
+    def ready(self) -> Dict:
+        raise NotImplementedError
+
+    def heartbeat(self, plan=None) -> bool:
+        """Supervisor probe: False means "treat me as dead". The
+        ``heartbeat-drop`` fault point (``rid`` = replica index) forces
+        a drop without killing anything — the router must migrate
+        anyway and the resumed streams must stay bit-identical."""
+        if plan is not None and plan.fire("heartbeat-drop",
+                                          rid=self.index):
+            return False
+        return self._probe()
+
+    def _probe(self) -> bool:
+        return self.alive()
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def _track(self, stream: ReplicaStream):
+        with self._lock:
+            self._streams.add(stream)
+
+    def _untrack(self, stream: ReplicaStream):
+        stream.closed = True
+        with self._lock:
+            self._streams.discard(stream)
+
+    def streams(self) -> List[ReplicaStream]:
+        with self._lock:
+            return list(self._streams)
+
+    # --------------------------------------------------------- lifecycle
+    def start(self):
+        raise NotImplementedError
+
+    def kill(self):
+        """Sudden death (chaos surface): no drain, no goodbyes."""
+        raise NotImplementedError
+
+    def stop(self):
+        """Graceful teardown (test/bench cleanup)."""
+        raise NotImplementedError
+
+    def restart(self):
+        """Replace the dead replica with a fresh one (the supervisor's
+        recovery arm); counted by the router's restart metric."""
+        raise NotImplementedError
+
+    # ----------------------------------------------------------- streams
+    def prepare(self, spec: StreamSpec, on_chunk, on_done,
+                on_broken) -> ReplicaStream:
+        """Phase 1: build the stream handle WITHOUT starting any flow,
+        so the caller can wire it up (attach it to a ticket) before the
+        first chunk can possibly arrive. ``launch`` starts the flow."""
+        stream = ReplicaStream(self, spec, on_chunk, on_done, on_broken)
+        self._track(stream)
+        return stream
+
+    def launch(self, stream: ReplicaStream):
+        raise NotImplementedError
+
+    def submit(self, spec: StreamSpec, on_chunk, on_done,
+               on_broken) -> ReplicaStream:
+        """prepare + launch in one call (single-consumer convenience;
+        the router uses the two-phase form)."""
+        stream = self.prepare(spec, on_chunk, on_done, on_broken)
+        self.launch(stream)
+        return stream
+
+    def _cancel(self, stream: ReplicaStream):
+        raise NotImplementedError
+
+
+class InProcReplica(Replica):
+    """An Engine+ServingFrontend replica in this process. ``factory()``
+    must return a STARTED :class:`~paddle_tpu.serving.frontend.
+    ServingFrontend` (or one this replica may start); restarts call it
+    again, so each incarnation gets a fresh engine and page pool."""
+
+    def __init__(self, factory: Callable, name: str = "inproc",
+                 index: int = 0):
+        super().__init__(name, index)
+        self._factory = factory
+        self._fe = None
+
+    @property
+    def frontend(self):
+        return self._fe
+
+    def start(self):
+        if self._fe is None:
+            self._fe = self._factory()
+            self._fe.start()
+        return self
+
+    def alive(self) -> bool:
+        return self._fe is not None and self._fe.alive
+
+    def ready(self) -> Dict:
+        if not self.alive():
+            return {"ready": False, "alive": False}
+        return self._fe.readiness()
+
+    def kill(self):
+        if self._fe is not None:
+            self._fe.poison()
+
+    def stop(self):
+        if self._fe is not None:
+            self._fe.shutdown()
+
+    def restart(self):
+        self._fe = self._factory()
+        self._fe.start()
+        self.restarts += 1
+        return self
+
+    def launch(self, stream: ReplicaStream):
+        spec = stream.spec
+
+        def bridge(chunk):
+            if stream.closed:
+                return
+            if chunk is None:
+                ticket = stream._impl
+                self._untrack(stream)
+                stream.on_done(stream,
+                               ticket.failure_reason if ticket else None)
+            else:
+                stream.on_chunk(stream, chunk)
+
+        stream._impl = self._fe.submit(
+            spec.prompt, spec.max_new_tokens,
+            temperature=spec.temperature, seed=spec.seed,
+            tenant=spec.tenant, deadline_s=spec.deadline_s,
+            on_chunk=bridge, resume_tokens=spec.resume_tokens)
+        return stream
+
+    def _cancel(self, stream: ReplicaStream):
+        self._untrack(stream)
+        if stream._impl is not None and self._fe is not None \
+                and self._fe.alive:
+            self._fe.cancel(stream._impl)
+
+
+class SubprocessReplica(Replica):
+    """A worker process behind the ApiServer HTTP protocol. ``argv`` is
+    the worker command line; the worker must print
+    ``api: http://HOST:PORT/...`` on stdout once bound (the
+    ``serve_llama_paged.py --api-port`` contract)."""
+
+    def __init__(self, argv: Sequence[str], name: str = "worker",
+                 index: int = 0, env: Optional[Dict[str, str]] = None,
+                 cwd: Optional[str] = None, startup_timeout_s: float = 120.0,
+                 probe_timeout_s: float = 2.0):
+        super().__init__(name, index)
+        self.argv = list(argv)
+        self.env = dict(env) if env is not None else None
+        self.cwd = cwd
+        self.startup_timeout_s = float(startup_timeout_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.proc: Optional[subprocess.Popen] = None
+        self.host: Optional[str] = None
+        self.port: Optional[int] = None
+
+    # --------------------------------------------------------- lifecycle
+    def start(self):
+        self.proc = subprocess.Popen(
+            self.argv, cwd=self.cwd, env=self.env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True)
+        bound = threading.Event()
+
+        def pump():
+            for line in self.proc.stdout:
+                if line.startswith("api: http") and not bound.is_set():
+                    # "api: http://127.0.0.1:PORT/v1/completions (...)"
+                    hostport = line.split("//", 1)[1].split("/", 1)[0]
+                    self.host, port = hostport.rsplit(":", 1)
+                    self.port = int(port)
+                    bound.set()
+            bound.set()  # EOF: unblock the waiter either way
+
+        # keep draining stdout for the worker's lifetime so its prints
+        # can never fill the pipe and wedge it
+        threading.Thread(target=pump, daemon=True,
+                         name=f"replica-{self.name}-stdout").start()
+        if not bound.wait(self.startup_timeout_s) or self.port is None:
+            raise RuntimeError(
+                f"replica {self.name!r} never printed its api endpoint "
+                f"(exit={self.proc.poll()})")
+        return self
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def _get_json(self, path: str):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=self.probe_timeout_s)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    def ready(self) -> Dict:
+        if not self.alive():
+            return {"ready": False, "alive": False}
+        try:
+            status, payload = self._get_json("/readyz")
+        except Exception:
+            return {"ready": False, "alive": True}
+        payload["ready"] = status == 200
+        return payload
+
+    def _probe(self) -> bool:
+        if not self.alive():
+            return False
+        try:
+            status, _ = self._get_json("/healthz")
+            return status == 200
+        except Exception:
+            return False
+
+    def kill(self):
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.kill()  # SIGKILL — the chaos gate's real crash
+
+    def stop(self):
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            try:
+                self.proc.send_signal(signal.SIGTERM)
+                self.proc.wait(timeout=60)
+            except Exception:
+                self.proc.kill()
+        try:
+            self.proc.wait(timeout=30)
+        except Exception:
+            pass
+
+    def restart(self):
+        self.stop()
+        self.host = self.port = None
+        self.start()
+        self.restarts += 1
+        return self
+
+    # ----------------------------------------------------------- streams
+    def launch(self, stream: ReplicaStream):
+        spec = stream.spec
+        payload = {"prompt": spec.prompt,
+                   "max_tokens": spec.max_new_tokens,
+                   "temperature": spec.temperature, "stream": True}
+        if spec.seed is not None:
+            payload["seed"] = int(spec.seed)
+        if spec.deadline_s is not None:
+            payload["deadline_ms"] = 1e3 * spec.deadline_s
+        if spec.resume_tokens:
+            payload["resume_tokens"] = list(spec.resume_tokens)
+        headers = {"Content-Type": "application/json"}
+        if spec.tenant:
+            headers["X-Tenant"] = spec.tenant
+        conn = http.client.HTTPConnection(self.host, self.port)
+        stream._impl = conn
+        threading.Thread(
+            target=self._pump_sse, daemon=True,
+            name=f"replica-{self.name}-stream",
+            args=(stream, conn, payload, headers)).start()
+        return stream
+
+    def _pump_sse(self, stream: ReplicaStream, conn, payload, headers):
+        """Per-stream reader thread: forward SSE chunks, classify the
+        ending — ``[DONE]`` is completion, anything else (socket reset,
+        EOF mid-stream: the SIGKILL signature) is a broken transport the
+        router must migrate."""
+        finish_reason = None
+        try:
+            conn.request("POST", "/v1/completions",
+                         json.dumps(payload), headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"replica {self.name!r} refused stream: "
+                    f"{resp.status} {resp.read()[:200]!r}")
+            done = False
+            while not done and not stream.cancelled:
+                line = resp.readline()
+                if not line:
+                    break  # EOF
+                line = line.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                data = line[6:]
+                if data == "[DONE]":
+                    done = True
+                    break
+                choice = json.loads(data)["choices"][0]
+                if choice.get("finish_reason") is not None:
+                    finish_reason = choice["finish_reason"]
+                toks = choice.get("token_ids") or []
+                if toks and not stream.closed:
+                    stream.on_chunk(stream, [int(t) for t in toks])
+            if stream.cancelled or stream.closed:
+                return
+            if not done:
+                raise ConnectionError(
+                    f"replica {self.name!r} stream ended without [DONE]")
+            self._untrack(stream)
+            stream.on_done(stream, None if finish_reason in (None, "stop")
+                           else finish_reason)
+        except Exception as e:
+            if stream.cancelled or stream.closed:
+                return
+            self._untrack(stream)
+            stream.on_broken(stream, e)
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _cancel(self, stream: ReplicaStream):
+        self._untrack(stream)
+        conn = stream._impl
+        if conn is not None:
+            try:
+                conn.close()  # server's disconnect-cancel frees the slot
+            except Exception:
+                pass

@@ -16,8 +16,21 @@ Endpoints (the vLLM-compatible subset):
   terminated by ``data: [DONE]``.
 * ``POST /v1/chat/completions`` — messages flattened and encoded the
   same way; chunks carry ``delta.content`` (+ ``token_ids``).
-* ``GET /healthz`` — 200 while serving, 503 while draining.
+* ``GET /healthz`` — LIVENESS: 200 whenever the process answers (even
+  draining/degraded — only a dead replica fails liveness; the payload
+  carries ``draining`` for the curious).
+* ``GET /readyz`` — READINESS (ISSUE 13): 200 only when fit for NEW
+  traffic — not draining, engine watchdog below its degradation
+  threshold, queue depth in bounds; 503 + ``Retry-After`` otherwise.
+  The multi-replica router (``serving/router.py``) gates routing here.
 * ``GET /v1/models`` — the single configured model id.
+
+Failover: completions accept ``resume_tokens`` (tokens the stream
+already emitted on a dead replica) — the engine re-admits
+prompt‖emitted and streams only the continuation, so a router can
+splice one uninterrupted client stream across replica deaths. 429s
+carry ``Retry-After`` derived from queue depth; engine-scoped faults
+map to 503 + taxonomy slug, never a bare 500.
 
 Tenancy: ``X-Tenant`` header (or the OpenAI ``user`` field) keys
 admission control and weighted fairness; unset lands on the default
@@ -37,7 +50,7 @@ import json
 import signal
 from typing import Dict, List, Optional, Tuple
 
-from ..inference.errors import EngineError, QueueFull
+from ..inference.errors import EngineError, QueueFull, RequestError
 from .frontend import ServingFrontend
 
 __all__ = ["ApiServer", "encode_text", "render_tokens"]
@@ -158,26 +171,52 @@ class ApiServer:
 
     @staticmethod
     async def _send(writer, status: int, payload: dict,
-                    keep_alive: bool = True) -> bool:
+                    keep_alive: bool = True,
+                    headers: Optional[Dict[str, str]] = None) -> bool:
         body = json.dumps(payload).encode()
         phrase = {200: "OK", 400: "Bad Request", 404: "Not Found",
-                  429: "Too Many Requests",
+                  429: "Too Many Requests", 500: "Internal Server Error",
                   503: "Service Unavailable"}.get(status, "OK")
         conn = "keep-alive" if keep_alive else "close"
+        extra = "".join(f"{k}: {v}\r\n" for k, v in (headers or {}).items())
         writer.write(
             f"HTTP/1.1 {status} {phrase}\r\n"
-            f"Content-Type: application/json\r\n"
+            f"Content-Type: application/json\r\n{extra}"
             f"Content-Length: {len(body)}\r\nConnection: {conn}\r\n"
             f"\r\n".encode() + body)
         await writer.drain()
         return keep_alive
 
+    def _retry_after_s(self) -> int:
+        """``Retry-After`` seconds for 429/503 responses, derived from
+        the queue depth the refused request would have waited behind:
+        roughly one second per max_slots-wide wave still queued, clamped
+        to [1, 30] so a hiccup never advertises an hour."""
+        eng = self.frontend.engine
+        depth = len(self.frontend.queue) + len(eng._queue)
+        return max(1, min(30, -(-depth // max(1, eng.max_slots))))
+
     async def _route(self, method, path, headers, body, writer) -> bool:
         if method == "GET" and path in ("/healthz", "/health"):
-            if self.frontend.draining:
-                return await self._send(writer, 503,
-                                        {"status": "draining"})
-            return await self._send(writer, 200, {"status": "ok"})
+            # LIVENESS (ISSUE 13): the split health surface. Answering
+            # at all means the process and event loop are up — always
+            # 200, even draining or degraded, so a supervisor only
+            # restarts a replica that is actually dead. Routability
+            # lives on /readyz.
+            return await self._send(writer, 200, {
+                "status": "ok",
+                "draining": bool(self.frontend.draining)})
+        if method == "GET" and path == "/readyz":
+            # READINESS: fit for NEW traffic — not draining, watchdog
+            # below its degradation threshold, queue depth in bounds.
+            # The multi-replica router health-gates routing on this.
+            ready = self.frontend.readiness()
+            if ready["ready"]:
+                return await self._send(writer, 200, {
+                    "status": "ready", **ready})
+            return await self._send(
+                writer, 503, {"status": "not-ready", **ready},
+                headers={"Retry-After": str(self._retry_after_s())})
         if method == "GET" and path == "/v1/models":
             return await self._send(writer, 200, {
                 "object": "list",
@@ -189,9 +228,21 @@ class ApiServer:
             except (ValueError, UnicodeDecodeError):
                 return await self._send(writer, 400, _err(
                     "invalid_json", "body is not valid JSON"))
-            return await self._completions(
-                payload, headers, writer,
-                chat=path.endswith("chat/completions"))
+            try:
+                return await self._completions(
+                    payload, headers, writer,
+                    chat=path.endswith("chat/completions"))
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                raise  # client went away — the conn handler's cleanup
+            except Exception as e:  # defense in depth: taxonomy 500,
+                # never a silently dropped connection (ISSUE 13
+                # satellite — engine-scoped faults map to the taxonomy)
+                try:
+                    return await self._send(writer, 500, _err(
+                        "internal",
+                        f"{type(e).__name__}: {e}"), keep_alive=False)
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    return False
         return await self._send(writer, 404, _err(
             "not_found", f"no route {method} {path}"))
 
@@ -234,6 +285,12 @@ class ApiServer:
         seed = payload.get("seed")
         stream = bool(payload.get("stream", False))
         deadline_ms = payload.get("deadline_ms")
+        resume = payload.get("resume_tokens")
+        if resume is not None and not (
+                isinstance(resume, list)
+                and all(isinstance(t, int) for t in resume)):
+            return await self._send(writer, 400, _err(
+                "validation", "resume_tokens must be a list of ints"))
         loop = asyncio.get_running_loop()
         chunks: asyncio.Queue = asyncio.Queue()
 
@@ -247,22 +304,48 @@ class ApiServer:
                 tenant=tenant,
                 deadline_s=(float(deadline_ms) / 1e3
                             if deadline_ms is not None else None),
-                on_chunk=on_chunk)
+                on_chunk=on_chunk, resume_tokens=resume)
         except QueueFull as e:
-            return await self._send(writer, 429, _err("queue_full",
-                                                      str(e)))
+            # backpressure carries a when-to-come-back hint (ISSUE 13
+            # satellite): derived from the depth of the queue the
+            # request would have waited behind
+            return await self._send(
+                writer, 429, _err("queue_full", str(e)),
+                headers={"Retry-After": str(self._retry_after_s())})
         except (EngineError, ValueError) as e:
-            return await self._send(writer, 400, _err(
-                getattr(e, "reason", "validation"), str(e)))
+            reason = getattr(e, "reason", "validation")
+            if isinstance(e, EngineError) and not isinstance(
+                    e, (RequestError, ValueError)):
+                # engine-scoped fault at submission: the server, not
+                # the request, is at fault — 503 with the taxonomy
+                # slug, never a bare 500
+                return await self._send(
+                    writer, 503, _err(reason, str(e)),
+                    headers={"Retry-After": str(self._retry_after_s())})
+            return await self._send(writer, 400, _err(reason, str(e)))
         rid = f"{'chatcmpl' if chat else 'cmpl'}-{id(ticket) & 0xFFFFFF:x}"
         if stream:
             return await self._stream(ticket, rid, chat, chunks, writer)
         return await self._unary(ticket, rid, chat, chunks, writer)
 
+    # failure reasons where the SERVER (not the request) is at fault:
+    # a unary response maps these to 503 + the taxonomy slug instead of
+    # a 200 with a surprising finish_reason (ISSUE 13 satellite)
+    _ENGINE_SCOPED_REASONS = frozenset(
+        {"engine", "step_fault", "unhandled", "pool_exhausted",
+         "retries_exhausted"})
+
     async def _unary(self, ticket, rid, chat, chunks, writer) -> bool:
         while await chunks.get() is not None:
-            pass  # accumulate until the end-of-stream sentinel
+            ticket.ack()  # the server IS the consumer here: chunks are
+            # accumulated on receipt, so receipt is consumption
         reason = _finish_reason(ticket)
+        if reason in self._ENGINE_SCOPED_REASONS:
+            return await self._send(
+                writer, 503, _err(reason,
+                                  "request failed on an engine-scoped "
+                                  "fault; safe to retry"),
+                headers={"Retry-After": str(self._retry_after_s())})
         text = render_tokens(ticket.tokens)
         if chat:
             choice = {"index": 0, "finish_reason": reason,
@@ -303,6 +386,11 @@ class ApiServer:
                                    "model": self.model_name,
                                    "choices": [choice]}))
                 await writer.drain()
+                # the chunk reached the client's socket buffer — ack so
+                # the frontend's slow-client watchdog sees progress; a
+                # stalled client blocks this drain, the ack clock
+                # stops, and the stream is cancelled (slot/pages freed)
+                ticket.ack()
             final = {"index": 0, "finish_reason": _finish_reason(ticket),
                      "token_ids": []}
             if chat:

@@ -31,6 +31,21 @@ Injection points (the engine's hook sites; see README "Failure semantics"):
   and the corruption is provably isolated to a cache MISS — never a wrong
   token (ISSUE 8).
 
+Multi-replica serving points (ISSUE 13 — consulted by the
+``serving/router.py`` supervisor loop; ``rid`` selects the REPLICA
+index here, reusing the per-request key):
+
+* ``replica-crash``    — kills/poisons the chosen replica at the k-th
+  supervisor tick (``rid=<replica index>`` picks the victim, ``at=k``
+  the tick): an in-process replica's engine thread vanishes without
+  finishing its tickets, a subprocess replica takes SIGKILL. Drives
+  crash detection → mid-stream migration → supervised restart.
+* ``heartbeat-drop``   — the chosen replica's heartbeat probe reports
+  failure while the replica itself stays up, driving the
+  false-positive/slow-network arm of crash detection: the router must
+  still migrate (and the cancel-before-resume path must keep the
+  client stream bit-identical).
+
 Training points (ISSUE 7 — consulted by ``distributed/checkpoint.py``,
 ``distributed/ckpt_manager.py`` and the ``hapi.Model.fit`` train loop):
 
@@ -95,6 +110,10 @@ POINTS = (
     "train-nan-loss",
     "preempt-signal",
     "slow-ckpt-write",
+    # multi-replica serving points (ISSUE 13 — consulted by
+    # serving/router.py's supervisor loop and Replica.heartbeat)
+    "replica-crash",
+    "heartbeat-drop",
 )
 
 

@@ -1,0 +1,96 @@
+"""TPL902 fixtures — unbounded retry loops in serving modules (the
+path filter keys on 'serving' in the filename, like serving_async.py).
+The failover layer (ISSUE 13) retries placements/migrations/restarts;
+a `while True` that swallows an exception and loops again must carry
+BOTH an attempt bound (comparison-guarded break/raise) and a backoff
+(sleep/wait between attempts) — missing either is a hot spin or a
+retry storm against whatever is failing."""
+import time
+
+from some_serving_lib import replica, taxonomy  # fixture stub
+
+
+def bad_no_bound_no_backoff(spec):
+    while True:  # EXPECT: TPL902
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            continue
+
+
+def bad_backoff_but_unbounded(spec):
+    while True:  # EXPECT: TPL902
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            time.sleep(0.1)
+
+
+def bad_bounded_but_hot(spec):
+    attempt = 0
+    while True:  # EXPECT: TPL902
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            attempt += 1
+            if attempt >= 5:
+                raise
+
+
+def bad_swallow_falls_through(spec, log):
+    while True:  # EXPECT: TPL902
+        try:
+            return replica.submit(spec)
+        except ConnectionError as e:
+            log.warning("retrying: %s", e)  # falls through -> retries
+
+
+def suppressed_poll_forever(spec):
+    # tpulint: disable=TPL902 -- fixture: deliberate spin, test-only
+    while True:  # EXPECT-SUPPRESSED: TPL902
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            continue
+
+
+def good_bounded_with_backoff(spec):
+    attempt = 0
+    while True:
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            attempt += 1
+            if attempt >= 5:
+                raise taxonomy.ReplicaLost("placement failed")
+            time.sleep(0.05 * (2 ** attempt))
+
+
+def good_for_range_with_backoff(spec):
+    # a for-range retry is bounded by construction; the backoff keeps
+    # it polite
+    for attempt in range(5):
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            time.sleep(0.05 * (2 ** attempt))
+    raise taxonomy.ReplicaLost("placement failed")
+
+
+def good_condition_is_the_bound(spec, stop_event):
+    # a real while-condition is the loop's own bound: the supervisor
+    # loop shape (Event.wait doubles as the backoff)
+    while not stop_event.is_set():
+        try:
+            replica.heartbeat()
+        except ConnectionError:
+            pass
+        stop_event.wait(0.1)
+
+
+def good_reraising_handler(spec):
+    while True:
+        try:
+            return replica.submit(spec)
+        except ConnectionError:
+            raise taxonomy.ReplicaLost("no retry: fail attributably")

@@ -99,7 +99,7 @@ class TestRegistry:
         assert set(RULES) == {
             "TPL101", "TPL102", "TPL201", "TPL301", "TPL302", "TPL303",
             "TPL304", "TPL401", "TPL402", "TPL501", "TPL502", "TPL503",
-            "TPL601", "TPL701", "TPL702", "TPL801", "TPL901",
+            "TPL601", "TPL701", "TPL702", "TPL801", "TPL901", "TPL902",
         }
         for r in RULES.values():
             assert r.description and r.name and r.family

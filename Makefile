@@ -30,7 +30,7 @@ chaos:
 		tests/test_train_resilience.py tests/test_prefix_cache.py \
 		tests/test_chunked_prefill.py tests/test_tp_serving.py \
 		tests/test_multi_step.py tests/test_api_server.py \
-		tests/test_replica_failover.py -q
+		tests/test_replica_failover.py tests/test_integrity.py -q
 
 # chaos-serve — the multi-replica failover suite alone (ISSUE 13):
 # SIGKILL/poison a replica mid-stream, assert every client stream
@@ -39,6 +39,16 @@ chaos:
 # chaos lane and iterate independently.
 chaos-serve:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_replica_failover.py -q
+
+# chaos-integrity — the silent-data-corruption suite alone (ISSUE 14):
+# every bit-flip-* fault point must be DETECTED (digest/checksum/shadow
+# probes), no injected corruption may ever produce a wrong delivered
+# token (streams bit-identical to uninjected runs after containment),
+# checkpoint restore must fall back to the newest verifying step, and a
+# weight-audit failure must drain the replica via /readyz with zero
+# failed requests. Subset of `chaos`.
+chaos-integrity:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q
 
 serve-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python \
@@ -54,4 +64,5 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: lint analyze chaos chaos-serve serve-smoke test onchip bench
+.PHONY: lint analyze chaos chaos-serve chaos-integrity serve-smoke test \
+	onchip bench

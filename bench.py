@@ -410,6 +410,21 @@ def bench_failover(cfg, on_tpu):
         return {"failover_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_integrity(cfg, on_tpu):
+    """Data-integrity scenario (ISSUE 14): the online-audit layer's
+    steady-state cost — weight-shard audits, per-page KV checksums at
+    splice/registration, shadow recompute — as an interleaved-rep ratio
+    of median scheduling-step times, sentinel strict vs off, on a
+    prefix-heavy workload. Gate: <2% median step overhead over the
+    50 ms single-core jitter floor, with >0 checks and 0 failures."""
+    try:
+        from paddle_tpu.inference.integrity import bench_integrity_overhead
+
+        return bench_integrity_overhead(cfg, on_tpu)
+    except Exception as e:
+        return {"integrity_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_resume(on_tpu):
     """Training-resilience scenario (ISSUE 7): amortized per-step
     checkpoint-save overhead through the raw train-step path — sync vs
@@ -627,6 +642,7 @@ def main():
     prefix = bench_prefix(decode_cfg, on_tpu)
     slo = bench_slo(decode_cfg, on_tpu)
     failover = bench_failover(decode_cfg, on_tpu)
+    integrity = bench_integrity(decode_cfg, on_tpu)
     resume = bench_resume(on_tpu)
     multichip = bench_multichip()
 
@@ -714,6 +730,18 @@ def main():
             metric_total("paddle_tpu_slow_client_cancels_total")),
         "failover_ttft_degrade": failover.get(
             "failover_ttft_degrade", 0.0),
+        # data-integrity surface (ISSUE 14): every audit probe and every
+        # detection across the whole run (checkpoint digests, weight
+        # audits, KV checksums, shadow recompute), plus the overhead
+        # block's own gate and the quarantine count
+        "integrity_checks": int(
+            metric_total("paddle_tpu_integrity_checks_total")),
+        "integrity_failures": int(
+            metric_total("paddle_tpu_integrity_failures_total")),
+        "replica_quarantines": int(
+            metric_total("paddle_tpu_replica_quarantines_total")),
+        "integrity_overhead_frac": integrity.get(
+            "integrity_overhead_frac", 0.0),
         # training-resilience surface (ISSUE 7): checkpoint commits and
         # the in-loop guard counters as the registry saw them
         "train_checkpoints": int(
@@ -765,6 +793,7 @@ def main():
         **prefix,
         **slo,
         **failover,
+        **integrity,
         **resume,
         **multichip,
         "metrics": metrics_block,

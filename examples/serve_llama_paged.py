@@ -215,6 +215,21 @@ def main():
                     help="bounded wait queue: add_request raises "
                          "QueueFull (backpressure) once this many "
                          "requests are waiting for a slot")
+    ap.add_argument("--integrity", choices=["off", "audit", "strict"],
+                    default="off",
+                    help="online silent-data-corruption defense "
+                         "(ISSUE 14): 'audit' arms load-time weight "
+                         "digests with periodic shard-slice audits and "
+                         "per-page KV checksums verified at every "
+                         "prefix-cache splice; 'strict' adds the "
+                         "shadow-recompute sentinel (one greedy row "
+                         "re-scored through the contiguous twin every "
+                         "N steps) and a tighter audit period. "
+                         "Detection is containment, not crash: KV "
+                         "corruption costs a cache miss, a weight-"
+                         "audit failure quarantines the replica "
+                         "(/readyz -> 503) so a router migrates and "
+                         "restarts it")
     ap.add_argument("--fault-inject", default=None,
                     help="deterministic fault-injection plan "
                          "(paddle_tpu.testing.faultinject grammar, e.g. "
@@ -330,7 +345,9 @@ def main():
                  prefix_cache=args.prefix_cache == "on",
                  prefill_chunk=args.prefill_chunk,
                  tp=args.tp, disaggregate=args.disaggregate,
-                 multi_step=args.multi_step)
+                 multi_step=args.multi_step,
+                 integrity=None if args.integrity == "off"
+                 else args.integrity)
     if eng.runner.sharded:
         print(f"tensor parallel: tp={eng.runner.tp} over "
               f"{[str(d) for d in eng.runner.mesh.devices.flat]}")

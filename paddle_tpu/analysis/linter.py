@@ -772,6 +772,64 @@ class _ModuleAnalyzer:
                           "taxonomy (raise a paddle_tpu.inference.errors "
                           "type or call a *fail*/*fault* handler)")
 
+    # -- TPL1002: swallowed IntegrityError (data-integrity family) ---------
+
+    _INTEGRITY_ROUTE_TAILS = ("fail", "fault", "quarantine", "invalidate")
+
+    def _handler_catches_integrity(self, h: ast.ExceptHandler) -> bool:
+        """True when the handler's TYPE names IntegrityError explicitly
+        (directly, dotted, or in a tuple). Broad handlers are TPL701's
+        jurisdiction — double-reporting the same line helps nobody."""
+        if h.type is None:
+            return False
+        types = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        return any((_tail_name(t) or "") == "IntegrityError"
+                   for t in types)
+
+    def _integrity_body_routes(self, h: ast.ExceptHandler) -> bool:
+        """The handler BODY (the type expression naming IntegrityError
+        must not self-satisfy the check) re-raises, calls a containment
+        handler (*fail*/*fault*/*quarantine*/*invalidate* — the
+        ``_fail_request`` / ``Watchdog.quarantine`` /
+        ``invalidate_page`` convention), or references another taxonomy
+        name — i.e. the detection demonstrably stays a detection."""
+        for stmt in h.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    return True
+                if isinstance(n, ast.Call):
+                    tail = (_tail_name(n.func) or "").lower()
+                    if any(t in tail for t in
+                           self._INTEGRITY_ROUTE_TAILS):
+                        return True
+                if isinstance(n, ast.Name) and n.id in self.err_aliases:
+                    return True
+                if isinstance(n, ast.Attribute) \
+                        and n.attr in self.err_aliases:
+                    return True
+        return False
+
+    def _check_integrity_handling(self):
+        """TPL1002 — integrity-bearing trees only (``inference``/
+        ``distributed``/``serving`` path components): catching a proven
+        corruption signal and dropping it re-silences the corruption
+        the whole ISSUE 14 layer exists to surface."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any(("inference" in p or "distributed" in p
+                    or "serving" in p) for p in parts):
+            return
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ExceptHandler) \
+                    and self._handler_catches_integrity(n) \
+                    and not self._integrity_body_routes(n):
+                self._add(R.SWALLOWED_INTEGRITY_ERROR, n,
+                          "`except IntegrityError` neither re-raises "
+                          "nor routes the detection into the taxonomy "
+                          "(call a *fail*/*fault*/*quarantine*/"
+                          "*invalidate* handler, or re-raise) — a "
+                          "swallowed integrity signal is silent "
+                          "corruption with a green dashboard")
+
     # -- TPL702: direct writes to checkpoint paths -------------------------
 
     _CKPT_PATH_HINTS = ("ckpt", "checkpoint", "step-")
@@ -1086,6 +1144,7 @@ class _ModuleAnalyzer:
 
     def _check_module_wide(self):
         self._check_error_handling()
+        self._check_integrity_handling()
         self._check_ckpt_writes()
         self._check_multihost_divergence()
         self._check_async_blocking()

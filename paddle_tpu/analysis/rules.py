@@ -40,6 +40,13 @@ Families (first digit of the numeric part):
   blocked coroutine stalls EVERY live token stream the event loop is
   multiplexing (ISSUE 12). Engine calls belong on the frontend's
   engine thread; anything else blocking belongs in an executor.
+* ``10xx`` — data integrity: exception discipline around the
+  silent-data-corruption defenses (ISSUE 14) in
+  ``paddle_tpu/{inference,distributed,serving}/``. An ``except`` that
+  can absorb an ``IntegrityError`` (a proven digest/checksum/shadow
+  mismatch) without re-raising or routing into the taxonomy turns a
+  detected corruption back into a silent one — strictly worse than
+  having no detector, because dashboards now show green.
 """
 from __future__ import annotations
 
@@ -216,6 +223,21 @@ UNBOUNDED_RETRY_LOOP = _rule(
     "sleep between them, and fail attributably (the taxonomy "
     "`replica_lost` / `retries_exhausted` reasons) when the bound is "
     "hit.")
+
+
+SWALLOWED_INTEGRITY_ERROR = _rule(
+    "TPL1002", "integrity", "swallowed-integrity-error",
+    "an `except` clause that can absorb IntegrityError (by catching it "
+    "explicitly, or broadly alongside it) in paddle_tpu/{inference,"
+    "distributed,serving}/ whose body neither re-raises nor routes the "
+    "detection into the taxonomy (a *fail*/*fault*/*quarantine*/"
+    "*invalidate* handler call, or constructing another taxonomy "
+    "error). IntegrityError is a PROVEN digest/checksum/shadow "
+    "mismatch — silent data corruption, caught (ISSUE 14). Swallowing "
+    "it un-catches it: the stream keeps flowing through corrupt state "
+    "and the integrity counters a fleet alerts on never move. Contain "
+    "instead: re-raise, quarantine the engine, invalidate the cached "
+    "state, or fail the request with its `integrity` reason.")
 
 
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -173,6 +173,7 @@ class CheckpointManager:
         self._writer = _ckpt.AsyncCheckpointer()
         self._fs_lock = threading.Lock()
         self._inflight_stage: set = set()
+        self._last_integrity_error = None  # newest skipped-corrupt cause
         # a previous incarnation may have died mid-save: reclaim its
         # staging dirs now, before the first write lands next to them
         self.gc()
@@ -237,23 +238,65 @@ class CheckpointManager:
     # ------------------------------------------------------------ restore
     def restore(self, step: Optional[int] = None, shardings=None,
                 mesh=None, specs=None) -> Tuple[int, Dict[str, Any]]:
-        """Load the newest (or a specific) committed checkpoint; returns
-        ``(step, state_dict)``. Raises ``FileNotFoundError`` when the
-        root has no committed checkpoint (or the requested step is
-        missing/incomplete)."""
-        if step is None:
-            step = self.latest_step()
-            if step is None:
+        """Load the newest VERIFYING (or a specific) committed
+        checkpoint; returns ``(step, state_dict)``.
+
+        Silent-corruption fallback (ISSUE 14): with ``step=None`` the
+        walk goes newest-first through ``list_steps`` and a step whose
+        content digests fail verification is SKIPPED (counted in
+        ``paddle_tpu_integrity_failures_total{target="checkpoint"}``)
+        instead of aborting the restore — a bit flipped in the newest
+        checkpoint costs one retention slot, not the training run.
+        Raises ``FileNotFoundError`` when the root has no committed
+        checkpoint (or the requested step is missing/incomplete), and —
+        only for an EXPLICIT ``step=`` — the typed ``IntegrityError``
+        when that step is committed but corrupt (an explicit step is a
+        human decision; silently loading a different one would be
+        worse than failing)."""
+        from ..inference.errors import IntegrityError
+
+        if step is not None:
+            path = self.step_path(step)
+            if not _ckpt.is_complete(path):
                 raise FileNotFoundError(
-                    f"no committed checkpoint under {self.root}")
-        path = self.step_path(step)
-        if not _ckpt.is_complete(path):
+                    f"checkpoint step-{step} under {self.root} is "
+                    "missing or incomplete")
+            state = _ckpt.load_state_dict(path, shardings=shardings,
+                                          mesh=mesh, specs=specs)
+            return int(step), state
+        steps = self.all_steps()
+        if not steps:
             raise FileNotFoundError(
-                f"checkpoint step-{step} under {self.root} is missing or "
-                "incomplete")
-        state = _ckpt.load_state_dict(path, shardings=shardings, mesh=mesh,
-                                      specs=specs)
-        return int(step), state
+                f"no committed checkpoint under {self.root}")
+        corrupt = []
+        for s in reversed(steps):
+            path = self.step_path(s)
+            try:
+                # cheap digest sweep first: a corrupt step is rejected
+                # before any array materializes or re-shards
+                _ckpt.verify_contents(path)
+                state = _ckpt.load_state_dict(path, shardings=shardings,
+                                              mesh=mesh, specs=specs)
+            except IntegrityError as e:
+                # fall back to the next-newest step — the whole point
+                # of keep-last-N retention under an SDC threat model
+                self._note_restore_fault(corrupt, s, e)
+                continue
+            return int(s), state
+        raise FileNotFoundError(
+            f"every committed checkpoint under {self.root} failed "
+            f"content verification (steps {corrupt}); nothing safe to "
+            "restore") from self._last_integrity_error
+
+    def _note_restore_fault(self, corrupt: list, step: int,
+                            exc: BaseException):
+        """One corrupt step skipped by the restore walk: the detection
+        stays attributable — the cause is retained (re-raised as the
+        chained exception when NOTHING verifies), the step recorded,
+        and the verify pass already counted it in
+        ``paddle_tpu_integrity_failures_total{target="checkpoint"}``."""
+        corrupt.append(int(step))
+        self._last_integrity_error = exc
 
     # ------------------------------------------------------------ metrics
     @staticmethod

@@ -69,6 +69,12 @@ class CacheCoordinator:
         model-runner so a sharded pool rebuilds per-shard."""
         eng = self.engine
         cfg = eng.cfg
+        ig = getattr(eng, "_integrity", None)
+        if ig is not None:
+            # page checksums describe buffers that are about to die
+            # (ISSUE 14); getattr because construction-time reset runs
+            # before the engine builds its sentinel
+            ig.reset_kv()
         n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
         store = jnp.int8 if eng.quantized else eng.dtype
         shape = (self.num_pages, self.page_size, n_kv * cfg.head_dim)
@@ -125,6 +131,12 @@ class CacheCoordinator:
         else:
             return None
         self.page_ref[page] = 1
+        ig = getattr(self.engine, "_integrity", None)
+        if ig is not None:
+            # the page is being handed to a NEW owner: any checksum
+            # recorded for its previous (cached) content is stale now —
+            # keeping it would fail the next registration vacuously
+            ig.forget_page(page)
         return page
 
     def release_page(self, page: int):

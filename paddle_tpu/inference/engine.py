@@ -146,6 +146,23 @@ output. TPU-first design instead of a C++ executor loop:
   iteration (admission waves, mixed chunk scheduling, spec drafting)
   keep classic stepping; ``paddle_tpu_engine_steps_per_roundtrip``
   records how many iterations each round trip actually batched.
+* **Data integrity (ISSUE 14).** ``Engine(integrity="audit"|"strict")``
+  arms the :class:`~paddle_tpu.inference.integrity.IntegritySentinel`
+  against SILENT data corruption — the failure class where nothing
+  raises and the engine streams confidently wrong tokens: load-time
+  per-tensor weight digests re-checked by a periodic idle-step shard
+  audit (mismatch → sticky watchdog QUARANTINE: the engine fail-stops,
+  ``/readyz`` drops, the router migrates streams and supervised-
+  restarts with verified weights); per-page KV checksums recorded at
+  prefix-cache registration and re-verified before every splice
+  commits (mismatch → invalidate-on-doubt + preempt active referents —
+  corruption costs a miss or an exact-resume recompute, never a
+  token); and, in strict mode, an every-N-steps shadow recompute of
+  one greedy row through the contiguous twin (divergence → that
+  request fails typed). Drive it with the ``bit-flip-weight`` /
+  ``bit-flip-kv`` fault points; ``make chaos-integrity`` asserts no
+  injected flip ever reaches a delivered token. See README "Data
+  integrity".
 * **Continuous telemetry (ISSUE 3).** Every scheduling step records the
   vLLM/Orca-style operational surface into the process-global metrics
   registry (``paddle_tpu.observability``): TTFT/TPOT/queue-wait
@@ -558,7 +575,7 @@ class Engine:
                  prefix_cache: bool = False,
                  prefill_chunk: Optional[int] = None,
                  tp: Optional[int] = None, disaggregate: bool = False,
-                 multi_step: int = 1):
+                 multi_step: int = 1, integrity=None):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -679,6 +696,19 @@ class Engine:
         self._spec_enabled = True
         self._slot_cap = max_slots
         self._watchdog = Watchdog(self, **(watchdog or {}))
+        # ---- data-integrity sentinel (ISSUE 14) -----------------------
+        # integrity="audit"|"strict"|dict|IntegrityConfig arms online
+        # SDC audits: load-time weight digests with periodic idle-step
+        # shard probes, per-page KV checksums verified at splice and
+        # re-registration, and (strict) an every-N-steps shadow
+        # recompute of one greedy row through the contiguous twin.
+        # Constructed LAST: the weight baseline digests the freshly
+        # placed _params, and the cache-coordinator's alloc hooks read
+        # the attribute via getattr (it does not exist during the
+        # coordinator's own construction above).
+        from .integrity import IntegritySentinel
+
+        self._integrity = IntegritySentinel.build(self, integrity)
 
     # --------------------------------------------- engine-core delegation
     # The tentpole split (ISSUE 11) moved pool/allocator state into the
@@ -1081,6 +1111,27 @@ class Engine:
             # with the prometheus counters below
             self._pcache.hits -= 1
             self._pcache.misses += 1
+        if matched and self._fi is not None \
+                and self._fi.fire("bit-flip-kv"):
+            # SILENT corruption (ISSUE 14): flip a matched idle page's
+            # device bytes with NO doubt signal — unlike the
+            # prefix-cache-corruption point above, nothing invalidates,
+            # so only the checksum probe below stands between this flip
+            # and a wrong token
+            doomed = pages[-1]
+            if int(self._page_ref[doomed]) == 0:
+                self._corrupt_page(doomed)
+        if matched and self._integrity is not None:
+            # close the PR 8 trust window: the token re-verify in
+            # PrefixCache.lookup proves the ENTRY matches the prompt,
+            # but said nothing about the page BYTES between
+            # registration and this splice — the checksum probe does
+            bad = self._integrity.verify_pages(pages)
+            if bad:
+                self._contain_kv_corruption(bad)
+                pages, matched = [], 0
+                self._pcache.hits -= 1
+                self._pcache.misses += 1
         if self._m is not None:
             (self._m.pc_hits if matched else self._m.pc_misses).inc()
         if not matched:
@@ -1121,14 +1172,50 @@ class Engine:
         the slot/row; once released they stay resident at refcount 0 until
         LRU eviction reclaims them. Blocks already cached keep their
         original page (the COW copy, in particular, stays private — its
-        final row diverges the moment decode appends into it)."""
+        final row diverges the moment decode appends into it).
+
+        With the integrity sentinel armed (ISSUE 14) every page now
+        backing these blocks gets a checksum: fresh pages record their
+        baseline, and an already-cached block's page — possibly parked
+        at refcount 0 since its first registration — is RE-verified, so
+        corruption of an idle page is caught at the earliest touch."""
         if self._pcache is None:
             return
         full = int(prefix.size) // self.page_size
         if full:
+            blocks = prefix[:full * self.page_size]
             self._pcache.register(
-                prefix[:full * self.page_size],
-                [int(row[i]) for i in range(full)])
+                blocks, [int(row[i]) for i in range(full)])
+            if self._integrity is not None:
+                # the canonical backing pages (dedup may differ from
+                # this row's private pages): peek, never re-stamp
+                pages, _ = self._pcache.lookup(blocks, touch=False)
+                bad = self._integrity.note_registered(pages)
+                if bad:
+                    self._contain_kv_corruption(bad)
+
+    def _contain_kv_corruption(self, bad_pages):
+        """Containment ladder, KV arm (ISSUE 14): a checksum-failed page
+        invalidates out of the cache with every descendant block (the
+        invalidate-on-doubt path — future lookups miss and recompute),
+        and any ACTIVE slot whose table references a bad page is
+        preempted: its KV may already be poisoned, and the recompute
+        requeue re-prefills prompt+generated exactly (the same
+        machinery replica migration rides), so the stream's delivered
+        tokens stay bit-identical. Corruption costs a miss or a
+        re-prefill — never a wrong token."""
+        dead = set()
+        for pg in bad_pages:
+            for p in self._pcache.invalidate_page(int(pg)):
+                dead.add(int(p))
+                if self._integrity is not None:
+                    self._integrity.forget_page(p)
+                if int(self._page_ref[p]) == 0:
+                    self._free_pages.append(p)
+        dead.update(int(p) for p in bad_pages)
+        for slot in list(self._active):
+            if any(int(p) in dead for p in self.tables[slot] if p):
+                self._preempt(slot)
 
     def _drop_cow_for(self, row):
         """Cancel pending COW copies whose destination lives in ``row`` —
@@ -2282,6 +2369,14 @@ class Engine:
         regardless. Token streams are bit-identical for every ``n``.
         Returns the number of live requests remaining (queued + active)."""
         t0 = time.perf_counter()
+        if self._watchdog.quarantined:
+            # fail-stop on proven corruption (ISSUE 14): a quarantined
+            # engine must not mint another token through weights its own
+            # audit proved corrupt — silence is recoverable (the router
+            # migrates stalled streams via resume-from-emitted, every
+            # delivered token predates the corruption), a wrong token is
+            # not. Requests stay live so the migration journal sees them.
+            return len(self._queue) + len(self._active)
         if self._fi is not None and self._fi.fire("slow-step"):
             time.sleep(self._fi.param("slow-step", "delay_ms", 20.0) / 1e3)
         if self._has_deadlines:
@@ -2301,6 +2396,12 @@ class Engine:
             else:
                 self._chained_step(t0)
             self._watchdog.note_step_ok()
+            if self._integrity is not None:
+                # online SDC audits (ISSUE 14): weight-shard probe on
+                # idle steps, shadow recompute every N — host-side,
+                # never raises (detections route through quarantine /
+                # _fail_request inside the sentinel)
+                self._integrity.on_step()
         except Exception as e:
             self._recover_step_fault(e)
         if self._m is not None:
@@ -2742,13 +2843,18 @@ class Engine:
         self._watchdog.note_acceptance(step_proposed, step_accepted)
 
     def run(self, requests=None) -> List[Request]:
-        """Serve ``requests`` (or whatever is queued) to completion."""
+        """Serve ``requests`` (or whatever is queued) to completion.
+        A quarantined engine (integrity fail-stop, ISSUE 14) returns
+        early with work still live — ``step()`` is a no-op there, and
+        spinning on it would never terminate; the multi-replica router
+        is the layer that finishes those streams elsewhere."""
         if requests:
             done = list(requests)
         else:
             done = list(self._queue)
         while self.step():
-            pass
+            if self._watchdog.quarantined:
+                break
         return done
 
 

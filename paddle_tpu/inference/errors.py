@@ -35,7 +35,7 @@ __all__ = [
     "EngineError", "RequestError", "ValidationError", "AdmissionRejected",
     "QueueFull", "DeadlineExceeded", "CancelledError", "PoolExhausted",
     "NumericsError", "DrafterFault", "StepFault", "CallbackError",
-    "RetriesExhausted", "EngineFault", "failure_reason",
+    "RetriesExhausted", "IntegrityError", "EngineFault", "failure_reason",
 ]
 
 
@@ -150,6 +150,27 @@ class RetriesExhausted(RequestError):
     failure."""
 
     reason = "retries_exhausted"
+
+
+class IntegrityError(RequestError):
+    """Silent data corruption detected by the integrity layer (ISSUE 14):
+    a checkpoint file's content digest no longer matches its metadata, a
+    KV page's checksum changed between registration and splice, a weight
+    shard's audit digest drifted from the load-time baseline, or a
+    shadow-recomputed token disagrees with the one the compiled path
+    delivered. The one taxonomy class whose *cause* is never the
+    request: the hardware (or a kernel) lied, and the containment ladder
+    decides the blast radius — cache miss (KV), request requeue/FAILED
+    (active KV / shadow divergence), replica quarantine (weights), or
+    restore fallback to an older step (checkpoint).
+
+    Handling discipline is enforced by tpulint TPL1002: an ``except``
+    that can absorb this class under ``paddle_tpu/{inference,
+    distributed,serving}/`` must re-raise or route into the taxonomy —
+    a swallowed integrity signal is exactly the silent corruption this
+    layer exists to surface."""
+
+    reason = "integrity"
 
 
 class EngineFault(EngineError):

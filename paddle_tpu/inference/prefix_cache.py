@@ -34,6 +34,21 @@ prefix into a page-table splice:
   failed integrity probe — costs future lookups a miss instead of wrong
   tokens. ``clear`` is the pool-reset flush (engine fault recovery must
   never serve pages whose backing buffers were rebuilt).
+* **The byte-trust window (ISSUE 14).** The verify-on-hit token compare
+  above proves the ENTRY is the right one — the host-side tokens stored
+  at registration match the prompt being admitted. It proves nothing
+  about the DEVICE BYTES the entry points at: between registration and a
+  later splice the page may sit idle (refcount 0) for arbitrarily long,
+  and a bit flipped in HBM during that window used to ride straight into
+  the spliced table and decode as confidently wrong tokens. That window
+  is now closed one layer up: the engine's ``IntegritySentinel``
+  (``inference/integrity.py``) records a per-page checksum when a block
+  registers and re-verifies it when the page is spliced
+  (``Engine._splice_prefix``) or re-registered — a mismatch routes
+  through this class's ``invalidate_page``, so the corruption degrades
+  to a miss exactly like a hash collision does. This module stays
+  device-blind on purpose; it only promises that every doubt signal has
+  an invalidation path.
 
 The class is pure host code (stdlib + numpy) and deliberately knows
 nothing about jax, devices, or the engine: the engine (and the draft-LM

@@ -53,6 +53,7 @@ import functools
 from typing import Dict, List, Optional, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["ModelRunner"]
@@ -192,6 +193,25 @@ class ModelRunner:
 
         sh = NamedSharding(self.mesh, self.page_spec)
         return [jax.device_put(a, sh) for a in arrays]
+
+    # ------------------------------------------------------ weight audit
+    def fetch_param_slice(self, i: int, start: int,
+                          stop: Optional[int]) -> np.ndarray:
+        """Host copy of elements ``[start, stop)`` (row-major flat
+        order; ``stop=None`` = whole tensor) of PLACED parameter ``i`` —
+        the integrity sentinel's audit probe (ISSUE 14). TP-aware the
+        same way the dispatches are: ``_params[i]`` carries its
+        ``NamedSharding``, so the eager ravel+slice runs under GSPMD
+        over the column/row shards and ``device_get`` assembles the
+        GLOBAL logical values. The digest baseline is therefore
+        layout-independent — a bit flipped in ANY shard's HBM lands in
+        the fetched window's bytes regardless of which device holds it,
+        and a tp=1 engine fetches the exact same values."""
+        p = self.engine._params[i]
+        flat = jnp.ravel(p)
+        if start or stop is not None:
+            flat = flat[int(start):(None if stop is None else int(stop))]
+        return np.asarray(jax.device_get(flat))
 
     # ------------------------------------------------------- local view
     @contextlib.contextmanager

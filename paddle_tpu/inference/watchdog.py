@@ -26,6 +26,16 @@ The current level is exported as the ``paddle_tpu_engine_degraded``
 gauge (0/1/2), so dashboards can alert on "engine survived but is
 running degraded" — the state the whole layer exists to make reachable.
 All of this is host-side scheduler code; nothing here is ever traced.
+
+**Quarantine (ISSUE 14)** is an orthogonal, STICKY axis on top of the
+levels: when the integrity sentinel proves the engine's own state is
+corrupt (a weight-audit digest mismatch — the weights every future
+token flows through), degrading throughput is the wrong tool. The
+engine is marked quarantined: readiness drops immediately (``/readyz``
+→ 503), the multi-replica router migrates every in-flight stream off
+and schedules a supervised restart, and — unlike the levels — nothing
+probes back up: only a fresh engine with re-verified weights clears it,
+because the corrupt copy can never re-earn trust from inside.
 """
 from __future__ import annotations
 
@@ -50,6 +60,8 @@ class Watchdog:
         self.accept_floor = float(accept_floor)
         self.recover_after = int(recover_after)
         self.level = HEALTHY
+        self.quarantined = False           # sticky integrity quarantine
+        self.quarantine_cause: Optional[BaseException] = None
         self.last_fault: Optional[BaseException] = None
         self._consec_step_faults = 0
         self._consec_drafter_faults = 0
@@ -106,6 +118,17 @@ class Watchdog:
             self.level = NO_SPEC
             self._apply()
 
+    def quarantine(self, cause: Optional[BaseException] = None):
+        """Integrity corruption proven (ISSUE 14): drop readiness NOW
+        and stay down. Sticky by design — see module docstring; the
+        router's quarantine arm migrates streams and restarts the
+        replica, and the restarted engine's fresh watchdog starts
+        clean."""
+        self.quarantined = True
+        self.quarantine_cause = cause
+        self.last_fault = cause if cause is not None else self.last_fault
+        self._apply()
+
     # ----------------------------------------------------- state machine
     def _degrade(self):
         if self.level < SMALL_BATCH:
@@ -130,14 +153,18 @@ class Watchdog:
         (drafting off costs throughput, not correctness), so it stays
         ready; SMALL_BATCH means the engine is actively shedding load —
         a router should stop sending it new streams and let it recover
-        while in-flight work completes."""
-        return self.level < SMALL_BATCH
+        while in-flight work completes. A quarantined engine (integrity
+        corruption, ISSUE 14) is never ready, whatever its level."""
+        return not self.quarantined and self.level < SMALL_BATCH
 
     def readiness(self) -> dict:
         """The structured readiness snapshot ``/readyz`` and the
-        multi-replica router consume."""
+        multi-replica router consume. ``quarantined`` is the router's
+        cue to migrate in-flight streams too, not just stop routing new
+        ones — the corrupt weights poison EXISTING streams' future
+        tokens, unlike an ordinary degraded level."""
         return {"ready": self.ready, "level": self.level,
-                "mode": self.mode}
+                "mode": self.mode, "quarantined": self.quarantined}
 
     def _apply(self):
         eng = self.engine
@@ -161,4 +188,5 @@ class Watchdog:
 
     @property
     def mode(self) -> str:
-        return _LEVEL_NAMES[self.level]
+        return "quarantined" if self.quarantined \
+            else _LEVEL_NAMES[self.level]

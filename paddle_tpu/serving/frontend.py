@@ -266,7 +266,13 @@ class ServingFrontend:
         return {"ready": bool(ready), "alive": self.alive,
                 "draining": self._draining,
                 "watchdog_level": wd["level"],
-                "watchdog_mode": wd["mode"], "queue_depth": queued,
+                "watchdog_mode": wd["mode"],
+                # integrity quarantine (ISSUE 14): tells the router to
+                # migrate IN-FLIGHT streams too, not just stop routing
+                # new ones — corrupt weights poison existing streams'
+                # future tokens, unlike ordinary degradation
+                "quarantined": bool(wd.get("quarantined", False)),
+                "queue_depth": queued,
                 "active": len(eng._active),
                 "inflight": len(self._live) + queued}
 
@@ -494,6 +500,14 @@ class ServingFrontend:
                     n = 1 if len(self.queue) else None
                     eng.step(n)
                     self._complete()
+                    if eng._watchdog.quarantined:
+                        # integrity fail-stop (ISSUE 14): the engine
+                        # refuses to mint tokens through corrupt
+                        # weights, so step() is a no-op — idle-wait
+                        # instead of hot-spinning until the router
+                        # fences this replica and migrates its streams
+                        self._wake.wait(timeout=self._idle_wait_s)
+                        self._wake.clear()
                     continue
                 self._complete()
                 if self._draining and not self._live \

@@ -23,7 +23,15 @@ The split health surface both implement (ISSUE 13):
 * **readiness** (``ready()``) — fit for NEW traffic: not draining,
   engine watchdog below its degradation threshold, queue depth in
   bounds. The router health-gates routing on this; a live-but-unready
-  replica keeps its in-flight streams and takes no new ones.
+  replica keeps its in-flight streams and takes no new ones. The
+  payload's ``quarantined`` field (ISSUE 14) is the one unreadiness
+  that is WORSE than death: the engine's own integrity audit proved
+  its weights corrupt, so the router must not merely stop routing new
+  streams — it fences the replica (kill) and migrates the in-flight
+  ones too, because their future tokens would flow through the same
+  corrupt weights. Both replica kinds surface it: the in-process one
+  straight from ``ServingFrontend.readiness()``, the subprocess one
+  through the ``/readyz`` JSON body (503s still carry the payload).
 * **heartbeat** (``heartbeat(plan)``) — the supervisor's periodic
   probe; the ``heartbeat-drop`` fault point (keyed by replica index via
   the plan's ``rid`` selector) makes it report failure while the

@@ -18,6 +18,14 @@ the replica-resilience layer above the PR 12 front-end:
   new. With nothing ready, placement falls back to any live replica
   (shedding to nowhere helps nobody), then retries with bounded
   backoff before failing the request attributably.
+* **Integrity quarantine (ISSUE 14).** A live replica reporting
+  ``quarantined`` (its weight audit caught silent corruption; the
+  engine has already fail-stopped) is fenced like a crash, only
+  sooner: the sweep kills it FIRST — before anything could route to
+  it — then the ordinary dead-replica machinery migrates its streams
+  (every delivered token predates the corruption, so resume-from-
+  emitted is still bit-exact) and supervised-restarts it with freshly
+  verified weights (``paddle_tpu_replica_quarantines_total``).
 * **Mid-stream migration (KV-free).** The router records each stream's
   prompt + every emitted token id. When a replica dies mid-stream —
   broken transport (the SIGKILL signature), heartbeat loss, or a stream
@@ -242,6 +250,11 @@ class Router:
         self._m_ready = gauge(
             "paddle_tpu_router_replicas_ready",
             "replicas currently passing the readiness gate")
+        self._m_quarantines = counter(
+            "paddle_tpu_replica_quarantines_total",
+            "replicas fenced off after an integrity-audit failure "
+            "(weight corruption): streams migrated, replica killed and "
+            "supervised-restarted with verified weights")
 
     # ------------------------------------------------------------ control
     def start(self) -> "Router":
@@ -454,6 +467,24 @@ class Router:
                                                       rid=idx):
                 rep.kill()
             up = rep.alive() and rep.heartbeat(self._fi)
+            if up:
+                # integrity quarantine (ISSUE 14 containment ladder,
+                # weight arm): a live replica whose weight audit failed
+                # is WORSE than a dead one — every token it would still
+                # produce flows through corrupt weights. Fence it FIRST
+                # (kill — the poison/SIGKILL surface), then let the
+                # normal dead-replica machinery below migrate its
+                # streams (resume-from-emitted, bit-identical) and
+                # schedule the supervised restart, which reloads
+                # verified weights through the replica factory.
+                try:
+                    quarantined = bool(rep.ready().get("quarantined"))
+                except Exception:
+                    quarantined = False
+                if quarantined:
+                    self._m_quarantines.inc()
+                    rep.kill()
+                    up = False
             with self._lock:
                 was_dead = idx in self._dead
                 if not up and not was_dead:

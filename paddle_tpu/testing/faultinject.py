@@ -63,6 +63,28 @@ Training points (ISSUE 7 — consulted by ``distributed/checkpoint.py``,
   if SIGTERM had arrived: the loop drains the step, force-commits a final
   checkpoint, and raises ``TrainingPreempted``.
 
+Silent-data-corruption points (ISSUE 14 — the bit-flip family. These
+damage data WITHOUT signaling doubt; the seed-driven offset/bit choice
+comes from the point's own PCG64 stream via :meth:`FaultPlan.draw`, so a
+failing chaos run replays the exact same flipped bit):
+
+* ``bit-flip-weight`` — flips one bit of one weight element on device
+  right before an ``IntegritySentinel`` weight-audit probe samples that
+  shard slice. The audit's digest comparison must catch it; containment
+  is the quarantine ladder (watchdog drops ``/readyz`` → the router
+  migrates streams off → supervised restart with verified weights).
+* ``bit-flip-kv``     — corrupts a matched, idle cached KV page's device
+  bytes at a prefix-cache hit WITHOUT invalidating it (contrast
+  ``prefix-cache-corruption``, which signals doubt): only the per-page
+  checksum probe at splice time stands between the flip and a wrong
+  token. Detection costs a cache miss, never a token.
+* ``bit-flip-ckpt``   — flips one seed-chosen bit of one seed-chosen
+  data file in the checkpoint staging dir after the content digests are
+  recorded but before the commit markers land: the checkpoint COMMITS
+  (completeness says nothing about content), and only the load-time
+  digest verification can refuse it — ``CheckpointManager.restore``
+  must fall back to the newest step that verifies.
+
 Spec grammar (``FLAGS_fault_inject`` / env ``PADDLE_TPU_FAULT_INJECT`` /
 ``Engine(fault_plan=...)``)::
 
@@ -114,6 +136,12 @@ POINTS = (
     # serving/router.py's supervisor loop and Replica.heartbeat)
     "replica-crash",
     "heartbeat-drop",
+    # silent-data-corruption points (ISSUE 14 — the damage is SILENT:
+    # unlike prefix-cache-corruption nothing signals doubt, so only the
+    # integrity layer's digests/checksums/shadow recompute can catch it)
+    "bit-flip-weight",
+    "bit-flip-kv",
+    "bit-flip-ckpt",
 )
 
 
@@ -203,7 +231,19 @@ class FaultPlan:
     def fire(self, point: str, rid: Optional[int] = None) -> bool:
         """Should ``point`` fault on this check? Deterministic in the
         sequence of calls; counts fires for ``fired()`` and the
-        ``paddle_tpu_faults_injected_total{point}`` counter."""
+        ``paddle_tpu_faults_injected_total{point}`` counter.
+
+        A name outside the :data:`POINTS` registry RAISES (ISSUE 14
+        satellite): a typo'd point in a hook site or a chaos test used
+        to return False forever, so the test asserted "no fault fired"
+        against an injection that never existed — vacuously green.
+        Valid points simply absent from this plan still return False."""
+        if point not in POINTS:
+            raise ValueError(
+                f"unregistered fault-injection point {point!r}; known "
+                f"points: {', '.join(POINTS)} (add new points to "
+                "testing.faultinject.POINTS so typos can never pass "
+                "chaos tests vacuously)")
         st = self._points.get(point)
         if st is None:
             return False
@@ -211,6 +251,19 @@ class FaultPlan:
         if hit:
             self._count(point)
         return hit
+
+    def draw(self, point: str, n: int) -> int:
+        """A deterministic draw in ``[0, n)`` from ``point``'s seeded
+        stream — the bit-flip family's offset/bit selector. Advances the
+        same PCG64 stream ``rate=`` uses, so the choice is reproducible
+        given the spec+seed and the sequence of calls."""
+        if point not in POINTS:
+            raise ValueError(
+                f"unregistered fault-injection point {point!r}")
+        st = self._points.get(point)
+        if st is None or n <= 0:
+            return 0
+        return int(st._rng.integers(0, n))
 
     def param(self, point: str, key: str, default: float) -> float:
         st = self._points.get(point)

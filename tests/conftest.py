@@ -60,6 +60,11 @@ def pytest_configure(config):
         "multihost: true multi-process test (subprocess workers rendezvous "
         "through jax.distributed); skips itself on the jaxlib-0.4.37 CPU "
         "backend's exact no-multiprocess-computations signature")
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the wall-clocked tier-1 lane (-m 'not "
+        "slow'); still enforced unconditionally by make test / make "
+        "chaos, which run with no marker filter")
 
 
 def _timeout_guard(item):

@@ -1,0 +1,69 @@
+"""TPL1002 fixtures (data-integrity family, ISSUE 14): swallowing a
+proven corruption signal vs routing it. The file name carries
+"inference" so the path-scoped rule engages, mirroring the other
+serving-path fixtures."""
+
+
+class IntegrityError(Exception):  # stand-in for the taxonomy class
+    reason = "integrity"
+
+
+from errors import StepFault  # noqa: E402,F401 - binds an err alias
+
+
+def _fail_request(req, exc):
+    req.failed = exc
+
+
+def quarantine(engine, exc):
+    engine.quarantined = True
+
+
+def swallowed_probe(engine, page):
+    try:
+        engine.verify(page)
+    except IntegrityError:  # EXPECT: TPL1002
+        pass  # detection silently un-detected
+
+
+def swallowed_with_logging(engine, page):
+    try:
+        engine.verify(page)
+    except IntegrityError as e:  # EXPECT: TPL1002
+        engine.log(f"integrity probe failed: {e}")  # logged != routed
+
+
+def routed_reraise(engine, page):
+    try:
+        engine.verify(page)
+    except IntegrityError:
+        raise  # clean: the caller contains
+
+
+def routed_to_taxonomy(engine, req, page):
+    try:
+        engine.verify(page)
+    except IntegrityError as e:
+        _fail_request(req, e)  # clean: *fail* handler call
+
+
+def routed_to_quarantine(engine, page):
+    try:
+        engine.verify(page)
+    except IntegrityError as e:
+        quarantine(engine, e)  # clean: *quarantine* handler call
+
+
+def routed_invalidate(cache, page):
+    try:
+        cache.verify(page)
+    except IntegrityError:
+        cache.invalidate_page(page)  # clean: *invalidate* handler call
+
+
+def suppressed_probe(engine, page):
+    try:
+        engine.verify(page)
+    # tpulint: disable=TPL1002 -- fixture: demonstrating suppression
+    except IntegrityError:  # EXPECT-SUPPRESSED: TPL1002
+        pass

@@ -1,0 +1,547 @@
+"""Silent-data-corruption chaos suite (ISSUE 14) — wired into
+``make chaos`` (and ``make chaos-integrity`` standalone).
+
+The contract under test, per bit-flip fault point:
+
+* **detection** — every injected flip is caught by the matching probe
+  (checkpoint file digest, KV page checksum, weight-audit digest,
+  shadow recompute) and lands in
+  ``paddle_tpu_integrity_failures_total{target}``;
+* **zero wrong tokens** — no injected corruption ever reaches a
+  delivered token: streams are bit-identical to uninjected runs after
+  containment (KV corruption costs a cache miss / a recompute
+  preemption; weight corruption fail-stops the engine BEFORE the next
+  token);
+* **recovery through the existing machinery** — checkpoint restore
+  walks back to the newest step whose every digest verifies
+  (chaos-asserted per committed file, plus a bit-flip at every byte
+  offset of one data file); a weight-audit failure drops ``/readyz``
+  and the router migrates every stream off the quarantined replica
+  with zero failed requests, then supervised-restarts it with verified
+  weights.
+"""
+import glob
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ck
+from paddle_tpu.distributed.ckpt_manager import CheckpointManager
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.inference.errors import IntegrityError
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import metric_total, render_prometheus
+from paddle_tpu.serving import InProcReplica, Router, ServingFrontend
+from paddle_tpu.testing.faultinject import FaultPlan
+
+VOCAB = 97
+PROMPT = list(range(1, 21))
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                    max_position=128, vocab_size=VOCAB)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def make_engine(gpt, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("prefix_cache", True)
+    kw.setdefault("integrity", "audit")
+    return Engine(gpt, **kw)
+
+
+SHARED = np.asarray(PROMPT[:16], np.int32)  # two full 8-token blocks
+
+
+def two_wave_workload(eng):
+    """Wave 1 registers the shared prefix; wave 2 re-admits it (the
+    splice/verify path). Returns both waves' requests in order."""
+    rng = np.random.default_rng(0)
+    w1 = [eng.add_request(
+        np.concatenate([SHARED, rng.integers(0, VOCAB, (3 + i,))]), 8)
+        for i in range(2)]
+    eng.run()
+    w2 = [eng.add_request(
+        np.concatenate([SHARED, rng.integers(0, VOCAB, (5 + i,))]), 8)
+        for i in range(2)]
+    eng.run()
+    return w1 + w2
+
+
+@pytest.fixture(scope="module")
+def clean(gpt):
+    """Uninjected token streams — the bit-identity target."""
+    eng = make_engine(gpt, integrity=None)
+    reqs = two_wave_workload(eng)
+    assert all(r.done and not r.failed for r in reqs)
+    return [list(r.tokens) for r in reqs]
+
+
+def _series_total(name, target=None):
+    """Per-target counter read (metric_total sums across label series)."""
+    from paddle_tpu.observability import REGISTRY
+
+    m = REGISTRY.get(name)
+    if m is None:
+        return 0.0
+    return float(sum(
+        leaf.value for key, leaf in m.series()
+        if target is None or target in key))
+
+
+def _fails(target):
+    return _series_total("paddle_tpu_integrity_failures_total", target)
+
+
+# ---------------------------------------------------------- fault plans
+class TestFaultPlanHardening:
+    def test_unregistered_point_raises(self):
+        plan = FaultPlan("slow-step:every=1")
+        with pytest.raises(ValueError, match="unregistered"):
+            plan.fire("slo-step")  # the typo that used to pass vacuously
+        with pytest.raises(ValueError, match="unregistered"):
+            plan.draw("bit-flip-kvv", 8)
+
+    def test_valid_point_absent_from_plan_is_false(self):
+        plan = FaultPlan("slow-step:every=1")
+        assert plan.fire("bit-flip-kv") is False
+
+    def test_draw_is_deterministic_per_seed(self):
+        a = FaultPlan("bit-flip-ckpt", seed=7)
+        b = FaultPlan("bit-flip-ckpt", seed=7)
+        seq_a = [a.draw("bit-flip-ckpt", 1000) for _ in range(8)]
+        seq_b = [b.draw("bit-flip-ckpt", 1000) for _ in range(8)]
+        assert seq_a == seq_b
+        c = FaultPlan("bit-flip-ckpt", seed=8)
+        assert [c.draw("bit-flip-ckpt", 1000)
+                for _ in range(8)] != seq_a
+
+
+# ------------------------------------------------- checkpoint integrity
+class TestCheckpointIntegrity:
+    def _two_steps(self, root):
+        mgr = CheckpointManager(root, keep_last_n=5)
+        state1 = {"w": np.full((3, 4), 1.0, np.float32),
+                  "b": np.arange(6, dtype=np.float32), "step": 1}
+        mgr.save(1, state1)
+        state2 = {"w": np.full((3, 4), 2.0, np.float32),
+                  "b": np.arange(6, dtype=np.float32) * 2.0, "step": 2}
+        mgr.save(2, state2)
+        return mgr
+
+    def test_digests_recorded_and_clean_roundtrip(self, tmp_path):
+        mgr = self._two_steps(str(tmp_path))
+        s, st = mgr.restore()
+        assert s == 2 and float(st["w"][0, 0]) == 2.0
+        # every chunk carries a digest and verify_contents re-hashes it
+        assert ck.verify_contents(mgr.step_path(2)) >= 2
+
+    def test_bit_flip_in_every_committed_file_falls_back(self, tmp_path):
+        """The per-file chaos matrix: for EVERY file of the newest
+        committed step — data files AND the metadata marker — flip one
+        bit, assert restore refuses the step and lands on the older
+        verifying one, then restore the byte."""
+        mgr = self._two_steps(str(tmp_path))
+        step2 = mgr.step_path(2)
+        files = sorted(os.listdir(step2))
+        assert any(f.endswith(".npy") for f in files)
+        assert any(f.startswith("metadata.p") for f in files)
+        for fname in files:
+            path = os.path.join(step2, fname)
+            off = os.path.getsize(path) // 2
+            with open(path, "r+b") as f:
+                f.seek(off)
+                orig = f.read(1)
+                f.seek(off)
+                f.write(bytes([orig[0] ^ 0x10]))
+            try:
+                s, st = mgr.restore()
+                assert s == 1, (
+                    f"flip in {fname} did not deflect restore")
+                assert float(st["w"][0, 0]) == 1.0
+            finally:
+                with open(path, "r+b") as f:
+                    f.seek(off)
+                    f.write(orig)
+        # all bytes restored: the newest step verifies again
+        s, _ = mgr.restore()
+        assert s == 2
+
+    def test_bit_flip_at_every_offset_of_one_file(self, tmp_path):
+        """The byte-level matrix (the ISSUE 7 torn-write idea applied
+        to CONTENT): a single-bit flip at any offset of a data file —
+        npy header included — must raise ``IntegrityError`` at load."""
+        mgr = self._two_steps(str(tmp_path))
+        step2 = mgr.step_path(2)
+        fname = sorted(f for f in os.listdir(step2)
+                       if f.startswith("b.") and f.endswith(".npy"))[0]
+        path = os.path.join(step2, fname)
+        size = os.path.getsize(path)
+        for off in range(size):
+            with open(path, "r+b") as f:
+                f.seek(off)
+                orig = f.read(1)
+                f.seek(off)
+                f.write(bytes([orig[0] ^ 0x01]))
+            with pytest.raises(IntegrityError):
+                ck.load_state_dict(step2)
+            with open(path, "r+b") as f:
+                f.seek(off)
+                f.write(orig)
+        ck.load_state_dict(step2)  # intact again
+
+    def test_bit_flip_ckpt_fault_point(self, tmp_path):
+        """``bit-flip-ckpt`` corrupts a staged file AFTER digesting,
+        BEFORE the markers: the checkpoint COMMITS (completeness is
+        satisfied) but verification refuses it and restore falls back."""
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+        mgr.save(1, {"w": np.ones((4, 4), np.float32)})
+        plan = FaultPlan("bit-flip-ckpt:at=1", seed=3)
+        mgr.fault_plan = plan
+        mgr.save(2, {"w": np.full((4, 4), 2.0, np.float32)})
+        assert plan.fired("bit-flip-ckpt") == 1
+        # committed: discovery sees step 2...
+        assert mgr.all_steps() == [1, 2]
+        # ...but content verification refuses it
+        with pytest.raises(IntegrityError):
+            ck.verify_contents(mgr.step_path(2))
+        s, st = mgr.restore()
+        assert s == 1 and float(st["w"][0, 0]) == 1.0
+
+    def test_explicit_corrupt_step_raises_not_redirects(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+        mgr.save(1, {"w": np.ones((4, 4), np.float32)})
+        mgr.fault_plan = FaultPlan("bit-flip-ckpt:at=1", seed=3)
+        mgr.save(2, {"w": np.full((4, 4), 2.0, np.float32)})
+        with pytest.raises(IntegrityError):
+            mgr.restore(step=2)
+
+    def test_all_steps_corrupt_is_attributable(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+        mgr.fault_plan = FaultPlan("bit-flip-ckpt:every=1", seed=3)
+        mgr.save(1, {"w": np.ones((4, 4), np.float32)})
+        mgr.save(2, {"w": np.full((4, 4), 2.0, np.float32)})
+        with pytest.raises(FileNotFoundError,
+                           match="failed content verification") as ei:
+            mgr.restore()
+        assert isinstance(ei.value.__cause__, IntegrityError)
+
+    def test_pre_digest_checkpoints_still_load(self, tmp_path):
+        """Back-compat: chunks without a digest key (older writers)
+        load unverified rather than failing."""
+        mgr = CheckpointManager(str(tmp_path), keep_last_n=5)
+        mgr.save(1, {"w": np.ones((2, 2), np.float32)})
+        import json as _json
+
+        mpath = glob.glob(os.path.join(mgr.step_path(1),
+                                       "metadata.p*.json"))[0]
+        with open(mpath) as f:
+            meta = _json.load(f)
+        meta.pop("self_digest", None)  # pre-digest writers had neither
+        for info in meta["tensors"].values():
+            for c in info["chunks"]:
+                c.pop("digest", None)
+        with open(mpath, "w") as f:
+            _json.dump(meta, f)
+        s, st = mgr.restore()
+        assert s == 1 and float(st["w"][0, 0]) == 1.0
+
+
+# ------------------------------------------------------ KV page audits
+class TestKVIntegrity:
+    def test_bit_flip_kv_detected_never_a_wrong_token(self, gpt, clean):
+        """The headline KV invariant: a silently flipped cached page is
+        caught by the checksum probe at splice, costs a MISS, and every
+        stream is bit-identical to the uninjected run."""
+        f0 = _fails("kv")
+        eng = make_engine(gpt, fault_plan="bit-flip-kv:at=1")
+        reqs = two_wave_workload(eng)
+        assert eng._fi.fired("bit-flip-kv") == 1
+        assert _fails("kv") > f0, "corruption was not detected"
+        assert all(r.done and not r.failed for r in reqs)
+        assert [list(r.tokens) for r in reqs] == clean
+        assert eng._integrity.last_error is not None
+
+    def test_corrupted_after_registration_caught_before_splice(
+            self, gpt, clean):
+        """The PR 8 trust-window satellite: a page corrupted while
+        PARKED (registered, refcount 0, between token re-verify and
+        use) is caught when the next admission tries to splice it."""
+        f0 = _fails("kv")
+        eng = make_engine(gpt)
+        rng = np.random.default_rng(0)
+        w1 = [eng.add_request(
+            np.concatenate([SHARED, rng.integers(0, VOCAB, (3 + i,))]),
+            8) for i in range(2)]
+        eng.run()
+        # the shared prefix is registered and idle now: corrupt one of
+        # its pages directly, with NO doubt signal
+        idle = [p for p in eng._pcache._by_page
+                if int(eng._page_ref[p]) == 0]
+        assert idle, "no parked cached page to corrupt"
+        eng._corrupt_page(idle[0])
+        w2 = [eng.add_request(
+            np.concatenate([SHARED, rng.integers(0, VOCAB, (5 + i,))]),
+            8) for i in range(2)]
+        eng.run()
+        reqs = w1 + w2
+        assert _fails("kv") > f0, "parked-page corruption missed"
+        assert all(r.done and not r.failed for r in reqs)
+        assert [list(r.tokens) for r in reqs] == clean
+        # containment routed through invalidate-on-doubt: the wave that
+        # met the poisoned page recomputed as a MISS (the freed page id
+        # itself may be re-registered with FRESH content afterwards)
+        assert eng._pcache.misses >= 1
+
+    def test_active_referent_is_preempted_and_exact(self, gpt):
+        """Containment ladder, requeue arm: when the corrupt page is
+        still REFERENCED by an active slot (a long stream that spliced
+        it), that request is preempted — recompute resumes it exactly —
+        instead of decoding poisoned KV."""
+        ref_eng = make_engine(gpt, integrity=None, chunk_size=1,
+                              max_chain=1)
+        long_req = ref_eng.add_request(SHARED, 24)
+        ref_eng.run()
+        want = list(long_req.tokens)
+
+        # chunk/chain 1 paces delivery to ~1 token per step so the
+        # stream is provably mid-flight when corruption strikes
+        eng = make_engine(gpt, chunk_size=1, max_chain=1)
+        pre0 = metric_total("paddle_serving_preemptions_total")
+        req = eng.add_request(SHARED, 24)
+        # step until the prompt is registered and decode is mid-flight
+        for _ in range(2):
+            eng.step()
+        assert not req.done
+        cached = [p for p in eng._pcache._by_page]
+        assert cached
+        eng._corrupt_page(cached[0])
+        # same-prefix admission probes the page, detects, preempts the
+        # active referent; both streams then recompute cleanly
+        req2 = eng.add_request(SHARED, 8)
+        eng.run()
+        assert req.done and not req.failed
+        assert list(req.tokens) == want
+        assert req2.done and not req2.failed
+        assert metric_total("paddle_serving_preemptions_total") > pre0
+
+    def test_zero_overlap_traffic_unaffected(self, gpt):
+        """No shared prefixes → no splices → the KV probe never fires a
+        failure and streams match the sentinel-off run."""
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(0, VOCAB, (9 + i,)) for i in range(3)]
+        out = {}
+        for key, integ in (("off", None), ("on", "audit")):
+            eng = make_engine(gpt, integrity=integ)
+            reqs = [eng.add_request(p, 8) for p in prompts]
+            eng.run()
+            assert all(r.done and not r.failed for r in reqs)
+            out[key] = [list(r.tokens) for r in reqs]
+        assert out["on"] == out["off"]
+
+
+# ------------------------------------------------------- weight audits
+class TestWeightAudit:
+    def test_bit_flip_weight_quarantines_and_fail_stops(self, gpt):
+        f0 = _fails("weights")
+        eng = make_engine(
+            gpt, fault_plan="bit-flip-weight:at=1",
+            chunk_size=1, max_chain=1,  # ~1 token/step: the audit (and
+            # the quarantine) provably lands mid-stream
+            integrity={"mode": "audit", "weight_audit_every": 1})
+        req = eng.add_request(np.asarray(PROMPT, np.int32), 16)
+        eng.run()  # returns early on quarantine (fail-stop)
+        assert eng._fi.fired("bit-flip-weight") == 1
+        assert _fails("weights") > f0
+        assert eng._watchdog.quarantined
+        assert not eng._watchdog.ready
+        assert eng._watchdog.readiness()["quarantined"]
+        assert eng._watchdog.mode == "quarantined"
+        # fail-stop: the engine mints NOTHING more through corrupt
+        # weights — further steps are no-ops, the request stays live
+        # (migration's job), and no token was delivered post-flip
+        n = len(req.tokens)
+        assert not req.done and not req.failed
+        for _ in range(3):
+            eng.step()
+        assert len(req.tokens) == n
+
+    def test_frontend_readiness_carries_quarantine(self, gpt):
+        eng = make_engine(
+            gpt, fault_plan="bit-flip-weight:at=1",
+            integrity={"mode": "audit", "weight_audit_every": 1})
+        fe = ServingFrontend(eng)
+        eng.add_request(np.asarray(PROMPT, np.int32), 4)
+        eng.run()
+        ready = fe.readiness()
+        assert ready["quarantined"] is True
+        assert ready["ready"] is False
+
+    def test_clean_engine_never_quarantines(self, gpt, clean):
+        eng = make_engine(
+            gpt, integrity={"mode": "audit", "weight_audit_every": 1})
+        reqs = two_wave_workload(eng)
+        assert not eng._watchdog.quarantined
+        assert [list(r.tokens) for r in reqs] == clean
+
+
+# ---------------------------------------------------- shadow recompute
+class TestShadowRecompute:
+    def test_clean_streams_pass_the_shadow(self, gpt, clean):
+        f0 = _fails("shadow")
+        c0 = _series_total("paddle_tpu_integrity_checks_total", "shadow")
+        # chain 1 keeps rows ACTIVE across steps so the per-step shadow
+        # probe has candidates (a deep chain finishes a wave before the
+        # sentinel's first turn); stream identity is chain-invariant
+        eng = make_engine(
+            gpt, max_chain=1,
+            integrity={"mode": "strict", "shadow_every": 1,
+                       "weight_audit_every": 0})
+        reqs = two_wave_workload(eng)
+        assert _series_total("paddle_tpu_integrity_checks_total",
+                             "shadow") > c0
+        assert _fails("shadow") == f0
+        assert all(r.done and not r.failed for r in reqs)
+        assert [list(r.tokens) for r in reqs] == clean
+
+    def test_divergent_token_is_caught_and_failed(self, gpt):
+        """Simulated kernel/SDC divergence: the delivered token is
+        tampered to something the contiguous twin provably rejects —
+        the shadow probe fails THAT request with reason ``integrity``."""
+        eng = make_engine(
+            gpt, chunk_size=1, max_chain=1,
+            integrity={"mode": "strict", "shadow_every": 1,
+                       "weight_audit_every": 0})
+        req = eng.add_request(np.asarray(PROMPT, np.int32), 16)
+        for _ in range(3):
+            eng.step()
+        assert req.tokens and not req.done
+        # tamper the delivered token to the twin's ARGMIN — the one
+        # token whose margin is maximal, so rejection is deterministic
+        # whatever the untrained model's tie structure looks like
+        from paddle_tpu.framework.tensor import Tensor
+
+        ids = np.concatenate(
+            [np.asarray(PROMPT, np.int32),
+             np.asarray(req.tokens[:-1], np.int32)])
+        logits = gpt.forward(Tensor._wrap(jnp.asarray(ids[None, :])))
+        row = np.asarray(logits._data[0, -1], np.float32)
+        req.tokens[-1] = int(row.argmin())
+        ok = eng._integrity.shadow_check()
+        assert ok is False
+        assert req.failed and req.failure_reason == "integrity"
+        assert isinstance(req.failure, IntegrityError)
+
+
+# ------------------------------------------------- router containment
+class TestQuarantineFailover:
+    @pytest.mark.slow  # chaos-enforced (make chaos / chaos-integrity run
+    # it unconditionally); out of tier-1's wall budget — 3 engine builds
+    # + a supervised restart on the single-core host
+    def test_weight_audit_failure_drains_replica_zero_failures(
+            self, gpt):
+        """The ISSUE 14 acceptance gate, weight arm: replica 0's weight
+        audit fails mid-stream → its ``/readyz`` reports quarantined →
+        the router fences it, migrates every stream (bit-identical via
+        resume-from-emitted), and supervised-restarts it with verified
+        weights. Zero failed requests throughout."""
+        ref_eng = Engine(gpt, max_slots=2, num_pages=64, page_size=8,
+                         chunk_size=1, max_chain=1, dtype=jnp.float32)
+        ref = ref_eng.add_request(np.asarray(PROMPT, np.int32), 16)
+        ref_eng.run()
+        reference = list(ref.tokens)
+
+        def fresh_model():
+            # every replica incarnation OWNS its model (seed-identical
+            # weights): a SHARED model would race a restarting engine's
+            # weight snapshot against a live engine's trace-time tensor
+            # swap (swapped_tensors), leaking tracers into _params
+            paddle.seed(0)
+            cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                            max_position=128, vocab_size=VOCAB)
+            model = GPTForCausalLM(cfg)
+            model.eval()
+            return model
+
+        def factory_poisoned():
+            eng = Engine(
+                fresh_model(), max_slots=2, num_pages=64, page_size=8,
+                chunk_size=1, max_chain=1, dtype=jnp.float32,
+                fault_plan="slow-step:every=1,delay_ms=30;"
+                           "bit-flip-weight:at=4",
+                integrity={"mode": "audit", "weight_audit_every": 1})
+            return ServingFrontend(eng)
+
+        def factory_clean():
+            eng = Engine(
+                fresh_model(), max_slots=2, num_pages=64, page_size=8,
+                chunk_size=1, max_chain=1, dtype=jnp.float32,
+                fault_plan="slow-step:every=1,delay_ms=30",
+                integrity={"mode": "audit", "weight_audit_every": 1})
+            return ServingFrontend(eng)
+
+        fails0 = metric_total("paddle_tpu_request_failures_total")
+        q0 = metric_total("paddle_tpu_replica_quarantines_total")
+        reps = [InProcReplica(factory_poisoned, name="q0", index=0),
+                InProcReplica(factory_clean, name="q1", index=1)]
+        router = Router(reps, heartbeat_s=0.05, stall_s=None,
+                        restart_dead=True, restart_backoff_s=0.05)
+        router.start()
+        try:
+            # pin the stream to the poisoned replica: submit while the
+            # clean one reports more load, by submitting both streams
+            # and letting least-loaded spread them across the pair
+            t0 = router.submit(PROMPT, 16)
+            t1 = router.submit(PROMPT, 16)
+            out0 = t0.result(timeout=180)
+            out1 = t1.result(timeout=180)
+            assert out0 == reference and out1 == reference
+            assert t0.failure_reason is None
+            assert t1.failure_reason is None
+            # the poisoned replica's audit fired and the router fenced
+            # it: quarantine counted, at least one stream migrated
+            assert metric_total(
+                "paddle_tpu_replica_quarantines_total") > q0
+            assert t0.migrations + t1.migrations >= 1
+            assert metric_total(
+                "paddle_tpu_request_failures_total") == fails0
+            # supervised restart brought q0 back with verified weights
+            deadline = time.monotonic() + 90
+            victim = reps[0]
+            while time.monotonic() < deadline and not (
+                    victim.alive() and victim.restarts >= 1):
+                time.sleep(0.1)
+            assert victim.alive() and victim.restarts >= 1
+            fresh = victim.frontend.engine
+            assert not fresh._watchdog.quarantined
+        finally:
+            router.shutdown()
+
+
+# ----------------------------------------------------------- telemetry
+class TestObservability:
+    def test_counters_are_scrape_visible(self, gpt):
+        eng = make_engine(gpt, fault_plan="bit-flip-kv:at=1")
+        two_wave_workload(eng)
+        text = render_prometheus()
+        assert "paddle_tpu_integrity_checks_total" in text
+        assert 'target="kv"' in text
+        assert "paddle_tpu_integrity_failures_total" in text
+
+    def test_sentinel_off_by_default_and_free(self, gpt):
+        eng = Engine(gpt, max_slots=2, num_pages=64, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        assert eng._integrity is None

@@ -30,7 +30,8 @@ chaos:
 		tests/test_train_resilience.py tests/test_prefix_cache.py \
 		tests/test_chunked_prefill.py tests/test_tp_serving.py \
 		tests/test_multi_step.py tests/test_api_server.py \
-		tests/test_replica_failover.py tests/test_integrity.py -q
+		tests/test_replica_failover.py tests/test_integrity.py \
+		tests/test_kv_tier.py -q
 
 # chaos-serve — the multi-replica failover suite alone (ISSUE 13):
 # SIGKILL/poison a replica mid-stream, assert every client stream
@@ -50,6 +51,15 @@ chaos-serve:
 chaos-integrity:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_integrity.py -q
 
+# chaos-tier — the tiered-KV-cache suite alone (ISSUE 15): streams must
+# be bit-identical tier-on vs tier-off across greedy/sampled/spec/
+# chunked/preemption, a demote/promote round trip must preserve page
+# bytes exactly, kv-spill-corrupt must checksum-fail into invalidate +
+# recompute-as-miss, and slow-host-copy must degrade hits to misses
+# without stalling the engine. Subset of `chaos`.
+chaos-tier:
+	JAX_PLATFORMS=cpu python -m pytest tests/test_kv_tier.py -q
+
 serve-smoke:
 	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python \
 		examples/serve_llama_paged.py --tiny --api-port 0 --api-smoke \
@@ -64,5 +74,5 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: lint analyze chaos chaos-serve chaos-integrity serve-smoke test \
-	onchip bench
+.PHONY: lint analyze chaos chaos-serve chaos-integrity chaos-tier \
+	serve-smoke test onchip bench

@@ -382,6 +382,21 @@ def bench_prefix(cfg, on_tpu):
         return {"prefix_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_kv_tier(cfg, on_tpu):
+    """Tiered-KV-cache scenario (ISSUE 15): a templated workload whose
+    cached working set is ~8x the paged pool, served with and without
+    the host-DRAM spill tier — sustained hit-rate >= 0.8 tier-on where
+    tier-off collapses < 0.2, effective prefill throughput no worse
+    than recompute (interleaved medians over the 50 ms single-core
+    jitter floor), every promotion checksum-verified, zero drops."""
+    try:
+        from paddle_tpu.inference.kv_tier import bench_kv_tier as run
+
+        return run(cfg, on_tpu)
+    except Exception as e:
+        return {"kv_tier_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_slo(cfg, on_tpu):
     """Serving-front-end SLO scenario (ISSUE 12): multi-step decode
     speedup (multi_step=4 >= 1.2x multi_step=1), an open-loop Poisson
@@ -640,6 +655,7 @@ def main():
     spec = bench_spec(decode_cfg, on_tpu)
     fault = bench_fault(decode_cfg, on_tpu)
     prefix = bench_prefix(decode_cfg, on_tpu)
+    kv_tier = bench_kv_tier(decode_cfg, on_tpu)
     slo = bench_slo(decode_cfg, on_tpu)
     failover = bench_failover(decode_cfg, on_tpu)
     integrity = bench_integrity(decode_cfg, on_tpu)
@@ -702,6 +718,21 @@ def main():
             metric_total("paddle_tpu_prefix_computed_prefill_tokens_total")),
         "prefix_evictions": int(
             metric_total("paddle_tpu_prefix_cache_evictions_total")),
+        # KV host-tier surface (ISSUE 15): the demote/promote ladder as
+        # the registry counters saw it across the run, beside the tier
+        # block's own hit-rate/throughput gates
+        "kv_tier_demotions": int(
+            metric_total("paddle_tpu_kv_tier_demotions_total")),
+        "kv_tier_promotions": int(
+            metric_total("paddle_tpu_kv_tier_promotions_total")),
+        "kv_tier_hits": int(
+            metric_total("paddle_tpu_kv_tier_hits_total")),
+        "kv_tier_drops": int(
+            metric_total("paddle_tpu_kv_tier_drops_total")),
+        "kv_tier_hit_rate_on": kv_tier.get("kv_tier_hit_rate_on", 0.0),
+        "kv_tier_hit_rate_off": kv_tier.get("kv_tier_hit_rate_off", 0.0),
+        "kv_tier_prefill_ratio": kv_tier.get(
+            "kv_tier_prefill_ratio", 0.0),
         # decode hot-path kernel surface (ISSUE 9): prompt chunks
         # streamed through mixed steps, and fused-slab-path dispatches
         # across the three consumers (verify / suffix / chunked)
@@ -791,6 +822,7 @@ def main():
         **spec,
         **fault,
         **prefix,
+        **kv_tier,
         **slo,
         **failover,
         **integrity,

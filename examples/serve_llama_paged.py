@@ -179,6 +179,18 @@ def main():
                          "block-aligned prompt prefixes into their page "
                          "table and prefill only the uncached suffix; "
                          "output tokens are identical either way")
+    ap.add_argument("--kv-host-pages", type=int, default=0,
+                    help="host-DRAM KV tier size in pages (ISSUE 15; "
+                         "needs --prefix-cache on): idle cached pages "
+                         "spill to a host slab asynchronously instead "
+                         "of being evicted, and a later hash-chain hit "
+                         "promotes them back checksum-verified — "
+                         "effective prefix-cache capacity grows to the "
+                         "slab for roughly one page copy per re-hit "
+                         "page. 0 (default) = tier off: no worker "
+                         "thread, byte-identical scheduling, existing "
+                         "behavior unchanged. Output tokens are "
+                         "identical either way")
     ap.add_argument("--tp", type=int, default=None,
                     help="tensor-parallel degree (ISSUE 11): shard the "
                          "engine's compiled programs over a tp-way mesh "
@@ -343,6 +355,7 @@ def main():
                  max_queue=args.max_queue,
                  fault_plan=args.fault_inject,
                  prefix_cache=args.prefix_cache == "on",
+                 kv_host_pages=args.kv_host_pages,
                  prefill_chunk=args.prefill_chunk,
                  tp=args.tp, disaggregate=args.disaggregate,
                  multi_step=args.multi_step,
@@ -399,6 +412,13 @@ def main():
         pc = eng._pcache
         print(f"prefix cache: {pc.hits} hits / {pc.misses} misses, "
               f"{pc.n_pages} pages resident, {pc.evictions} evictions")
+    if eng.kv_tier is not None:
+        t = eng.kv_tier
+        print(f"kv tier: {t.demotions} demotions / {t.promotions} "
+              f"promotions, {t.hits} tier hits, {t.drops} drops, "
+              f"{t.host_pages - len(t._free_hslots)}/{t.host_pages} "
+              "host pages resident")
+        eng._cache.shutdown_tier()
     if eng._spec is not None:
         s = eng._spec.stats()
         print(f"spec[{s['drafter']}] k={s['k']}: "

@@ -830,6 +830,74 @@ class _ModuleAnalyzer:
                           "swallowed integrity signal is silent "
                           "corruption with a green dashboard")
 
+    # -- TPL1101: sync page-buffer transfer on the scheduling thread -------
+
+    _PAGE_TOKENS = {"pages_flat", "k_pages", "v_pages", "scale_pages"}
+    _TIER_WORKER_HINTS = ("worker", "spill")
+
+    def _raw_page_expr(self, node) -> bool:
+        """True when ``node`` is a RAW expression over the paged pool's
+        buffers: it names a page list (directly, dotted, subscripted)
+        and contains no call — a call result (a jitted reduction, a
+        scalar checksum) is a computed value whose transfer is small by
+        construction, not a page-byte fetch."""
+        toks = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                return False
+            if isinstance(n, ast.Name):
+                toks.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                toks.add(n.attr)
+        return bool(toks & self._PAGE_TOKENS)
+
+    def _sync_fetch_target(self, call: ast.Call):
+        """The transferred expression when ``call`` is a synchronous
+        device->host fetch: jax.device_get(x), np.asarray(x), or
+        x.block_until_ready(); else None."""
+        fn = call.func
+        if (_tail_name(fn) == "device_get"
+                or _dotted(fn) in ("np.asarray", "numpy.asarray")):
+            return call.args[0] if call.args else None
+        if isinstance(fn, ast.Attribute) and fn.attr == "block_until_ready":
+            return fn.value
+        return None
+
+    def _check_page_host_sync(self):
+        """TPL1101 — inference modules only: the engine-thread hot paths
+        (``Engine.step``'s dispatch/harvest spine, the cache-
+        coordinator's allocator) must never block on page BYTES crossing
+        the device boundary; the spill worker (function names carrying
+        'worker'/'spill') is the one sanctioned site."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("inference" in p for p in parts):
+            return
+
+        def walk(node, fn_stack):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    walk(child, fn_stack + [child.name.lower()])
+                    continue
+                if isinstance(child, ast.Call) and not any(
+                        h in name for name in fn_stack
+                        for h in self._TIER_WORKER_HINTS):
+                    target = self._sync_fetch_target(child)
+                    if target is not None \
+                            and self._raw_page_expr(target):
+                        self._add(
+                            R.SYNC_PAGE_TRANSFER_IN_HOT_PATH, child,
+                            "synchronous device->host transfer of KV "
+                            "page buffers on the scheduling thread "
+                            "(engine hot path); dispatch a gather and "
+                            "hand the handles to the spill worker "
+                            "(ModelRunner.capture_pages), or move the "
+                            "blocking fetch into a *worker*/*spill* "
+                            "function")
+                walk(child, fn_stack)
+
+        walk(self.tree, [])
+
     # -- TPL702: direct writes to checkpoint paths -------------------------
 
     _CKPT_PATH_HINTS = ("ckpt", "checkpoint", "step-")
@@ -1145,6 +1213,7 @@ class _ModuleAnalyzer:
     def _check_module_wide(self):
         self._check_error_handling()
         self._check_integrity_handling()
+        self._check_page_host_sync()
         self._check_ckpt_writes()
         self._check_multihost_divergence()
         self._check_async_blocking()

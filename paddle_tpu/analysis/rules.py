@@ -47,6 +47,13 @@ Families (first digit of the numeric part):
   mismatch) without re-raising or routing into the taxonomy turns a
   detected corruption back into a silent one — strictly worse than
   having no detector, because dashboards now show green.
+* ``11xx`` — KV-tier transfer discipline (ISSUE 15): the paged pool's
+  page buffers may only cross the device→host boundary on the spill
+  worker thread. A synchronous page-buffer fetch on the scheduling
+  thread (``Engine.step`` / ``CacheCoordinator`` hot paths) serializes
+  every dispatch behind a PCIe-sized copy; the async capture-dispatch
+  + background-worker split exists so demotion never costs the engine
+  thread more than a gather dispatch.
 """
 from __future__ import annotations
 
@@ -223,6 +230,23 @@ UNBOUNDED_RETRY_LOOP = _rule(
     "sleep between them, and fail attributably (the taxonomy "
     "`replica_lost` / `retries_exhausted` reasons) when the bound is "
     "hit.")
+
+
+SYNC_PAGE_TRANSFER_IN_HOT_PATH = _rule(
+    "TPL1101", "kv-tier", "sync-page-transfer-in-hot-path",
+    "a synchronous device->host transfer of KV PAGE BUFFERS "
+    "(jax.device_get / np.asarray / .block_until_ready over an "
+    "expression reaching pages_flat/k_pages/v_pages/scale_pages) in an "
+    "inference-module function outside the KV spill worker. The paged "
+    "pool is the engine's largest resident state; fetching page bytes "
+    "on the scheduling thread serializes the dispatch pipeline behind "
+    "a PCIe-sized copy every step — exactly the stall the host tier's "
+    "background spill worker (inference/kv_tier.py, function names "
+    "carrying 'worker'/'spill') exists to absorb. Dispatch a gather "
+    "and hand the HANDLES to the worker (ModelRunner.capture_pages), "
+    "or move the blocking fetch into the worker. Reductions are fine: "
+    "transferring a jitted function's output (one scalar per page, "
+    "e.g. the integrity checksums) is not a page-buffer fetch.")
 
 
 SWALLOWED_INTEGRITY_ERROR = _rule(

@@ -40,7 +40,8 @@ __all__ = ["CacheCoordinator"]
 class CacheCoordinator:
     """Paged KV pool + allocator; see module docstring."""
 
-    def __init__(self, engine, prefix_cache: bool = False):
+    def __init__(self, engine, prefix_cache: bool = False,
+                 kv_host_pages: int = 0):
         self.engine = engine
         cfg = engine.cfg
         self.num_pages = engine.num_pages
@@ -51,6 +52,21 @@ class CacheCoordinator:
         self.lengths = np.zeros((engine.max_slots,), np.int32)
         self.page_ref = np.zeros((self.num_pages,), np.int32)
         self.pcache = PrefixCache(self.page_size) if prefix_cache else None
+        # host-DRAM spill tier (ISSUE 15): eviction of idle cached pages
+        # becomes an async demotion and a later hash-chain hit an async
+        # checksum-verified promotion — effective cache capacity grows
+        # to the host slab without the engine thread ever blocking on a
+        # device<->host page copy
+        self.tier = None
+        if kv_host_pages:
+            if self.pcache is None:
+                raise ValueError(
+                    "kv_host_pages > 0 requires prefix_cache=True (the "
+                    "host tier spills idle PREFIX-CACHE pages; without "
+                    "the cache there is nothing to demote)")
+            from .kv_tier import HostTier
+
+            self.tier = HostTier(self, kv_host_pages)
         self.cow_pending: List = []  # (src, dst) device copies owed
         self.free_pages: List[int] = []
         self.free_slots: List[int] = []
@@ -94,16 +110,27 @@ class CacheCoordinator:
         self.free_pages = list(range(self.num_pages - 1, 0, -1))
         self.free_slots = list(range(eng.max_slots - 1, -1, -1))
         # the prefix cache maps token hashes to PAGE CONTENT — content
-        # that just died with the buffers; flush it and every refcount
+        # that just died with the buffers; flush it and every refcount.
+        # The host tier flushes with it (ISSUE 15): its copies were
+        # captured from the pool that just died mid-fault, and spill
+        # state that predates a fault is never served.
         self.page_ref[:] = 0
         if self.pcache is not None:
             self.pcache.clear()
+        if self.tier is not None:
+            self.tier.reset()
         self.cow_pending = []
 
     def pages_flat(self) -> List:
         out = list(self.k_pages) + list(self.v_pages)
         if self.engine.quantized:
             out += list(self.scale_pages)
+        if self.tier is not None:
+            # queued demotions capture NOW, before whatever dispatch
+            # asked for the buffers can overwrite the surrendered pages
+            # (every program reaches the pool through this call — the
+            # same choke-point guarantee _flush_cow leans on)
+            self.tier.flush_captures(out)
         return out
 
     def set_pages(self, pages_flat):
@@ -117,14 +144,25 @@ class CacheCoordinator:
     # ------------------------------------------------------- allocator
     def alloc_page(self) -> Optional[int]:
         """Claim one physical page (refcount 1): free list first, then
-        LRU eviction of an idle prefix-cache page — cached pages are
-        reclaimed BEFORE any active request is preempted."""
+        LRU reclamation of an idle prefix-cache page — cached pages are
+        reclaimed BEFORE any active request is preempted. With the host
+        tier armed (ISSUE 15) reclamation DEMOTES instead of evicting:
+        the victim's bytes start their async spill to host DRAM (the
+        capture gather is dispatched before the page changes owner) and
+        the chain entry survives, promotable on a later hit."""
         if self.free_pages:
             page = self.free_pages.pop()
         elif self.pcache is not None:
-            page = self.pcache.evict_lru(self.page_ref)
-            if page is None:
-                return None
+            if self.tier is not None:
+                taken = self.pcache.take_for_demotion(self.page_ref)
+                if taken is None:
+                    return None
+                page, ent = taken
+                self.tier.demote(page, ent)
+            else:
+                page = self.pcache.evict_lru(self.page_ref)
+                if page is None:
+                    return None
             m = self.engine._m
             if m is not None:
                 m.pc_evictions.inc()
@@ -161,6 +199,21 @@ class CacheCoordinator:
         if self.pcache is not None:
             n += self.pcache.evictable_count(self.page_ref)
         return n
+
+    # ------------------------------------------------------- host tier
+    def drain_tier(self):
+        """Apply the spill worker's completions (no-op without a tier):
+        finished demotions become host-resident entries, verified
+        promotions splice back into the pool. Engine thread only —
+        called at step/admission boundaries."""
+        if self.tier is not None:
+            self.tier.drain()
+
+    def shutdown_tier(self):
+        """Stop the spill worker (frontend drain/shutdown, replica
+        quarantine/restart). Idempotent no-op without a tier."""
+        if self.tier is not None:
+            self.tier.stop()
 
     # ----------------------------------------------------- COW / faults
     def flush_cow(self, copy_fn):

@@ -66,7 +66,7 @@ import numpy as np
 from .errors import IntegrityError
 
 __all__ = ["IntegrityConfig", "IntegritySentinel",
-           "bench_integrity_overhead"]
+           "count_integrity_check", "bench_integrity_overhead"]
 
 
 def _counter(name: str, help_: str):
@@ -83,6 +83,15 @@ def _count_check(target: str, ok: bool, n: int = 1):
         _counter("paddle_tpu_integrity_failures_total",
                  "data-integrity verifications that FAILED, by audit "
                  "target").labels(target=target).inc()
+
+
+def count_integrity_check(target: str, ok: bool, n: int = 1):
+    """Public recording surface for integrity verifications performed
+    OUTSIDE the sentinel — the KV host tier's promote-time digest
+    compare (ISSUE 15, ``target="kv_tier"``) lands on the same
+    ``paddle_tpu_integrity_{checks,failures}_total`` pair the fleet
+    alerts on, whether or not an ``IntegritySentinel`` is armed."""
+    _count_check(target, ok, n)
 
 
 class IntegrityConfig:
@@ -317,6 +326,22 @@ class IntegritySentinel:
         """The page left the cache (eviction, invalidation, realloc for
         new content) — its stored sum no longer describes anything."""
         self._page_sum.pop(int(page), None)
+
+    def sum_of_page(self, page: int) -> Optional[float]:
+        """The stored device-side checksum for ``page`` (None when the
+        page was never registered). The KV host tier reads it at
+        demotion so the sum can travel with the spilled bytes
+        (ISSUE 15)."""
+        return self._page_sum.get(int(page))
+
+    def adopt_page_sum(self, page: int, s: float):
+        """Checksum-verified promotion (ISSUE 15): the tier restored a
+        page whose bytes hash-matched their demotion-time digest, so
+        the device-side sum recorded before the round trip describes
+        the new physical page too — re-adopting it keeps the splice-
+        time probe (:meth:`verify_pages`) guarding promoted pages
+        exactly like never-demoted ones."""
+        self._page_sum[int(page)] = float(s)
 
     def reset_kv(self):
         """Pool reset: the buffers (and every checksum over them) died."""

@@ -34,6 +34,24 @@ prefix into a page-table splice:
   failed integrity probe — costs future lookups a miss instead of wrong
   tokens. ``clear`` is the pool-reset flush (engine fault recovery must
   never serve pages whose backing buffers were rebuilt).
+* **Tiered entries (ISSUE 15).** With the host-DRAM spill tier armed,
+  eviction becomes DEMOTION: the victim entry stays in the index but its
+  ``tier`` leaves ``"hbm"`` (``"spilling"`` while the background copy is
+  in flight, ``"host"`` once the bytes land in the host slab,
+  ``"promoting"`` while a copy back is in flight) and its device page is
+  surrendered for reuse. ``lookup`` splices only the HBM-resident chain
+  prefix — a demoted block is a MISS for this admission (the request
+  rides partial-prefill for the suffix) but ``tiers=True`` additionally
+  returns the matched demoted entries so the owner can promote them for
+  the next one. LRU stamps span the tiers (one clock), demotion picks
+  HBM victims whose children are already off-HBM (the index keeps every
+  entry reachable, so demotion — unlike removal — can never strand a
+  descendant), and host-capacity eviction drops oldest leaf-first
+  exactly like the old device-tier eviction did. This class still knows
+  nothing about devices or bytes: tier strings and host slots are
+  opaque bookkeeping the owner (``kv_tier.HostTier``) drives, and the
+  ``owner_release`` callback tells that owner when an entry leaves the
+  index (or re-binds to a device page) so host slots can be reclaimed.
 * **The byte-trust window (ISSUE 14).** The verify-on-hit token compare
   above proves the ENTRY is the right one — the host-side tokens stored
   at registration match the prompt being admitted. It proves nothing
@@ -68,7 +86,8 @@ __all__ = ["PrefixCache"]
 class _Entry:
     """One cached full block: a physical page plus the chain identity."""
 
-    __slots__ = ("key", "page", "tokens", "parent", "children", "stamp")
+    __slots__ = ("key", "page", "tokens", "parent", "children", "stamp",
+                 "tier", "hslot", "job")
 
     def __init__(self, key: bytes, page: int, tokens: np.ndarray,
                  parent: Optional[bytes], stamp: int):
@@ -78,6 +97,14 @@ class _Entry:
         self.parent = parent          # parent block's key (None at root)
         self.children: set = set()    # keys of cached child blocks
         self.stamp = stamp            # LRU clock at last touch
+        # host-DRAM tier state (ISSUE 15): "hbm" entries back a live
+        # device page; demotion walks hbm -> spilling -> host and
+        # promotion host -> promoting -> hbm. hslot is the host-slab
+        # row while host-resident; job is an owner-issued token so a
+        # stale async completion (the entry moved on) is discarded.
+        self.tier: str = "hbm"
+        self.hslot: Optional[int] = None
+        self.job: int = 0
 
 
 class PrefixCache:
@@ -92,6 +119,12 @@ class PrefixCache:
         self.hits = 0        # lookups that matched >= 1 block
         self.misses = 0      # lookups that matched nothing
         self.evictions = 0   # pages reclaimed by evict_lru
+        # host-tier owner hook (ISSUE 15): called with the entry whenever
+        # its host-side residency ends without the owner's own promote
+        # path doing it — removal from the index, or a re-bind back to a
+        # device page. The owner reclaims the host slot and invalidates
+        # any in-flight async job. None when no tier is armed.
+        self.owner_release = None
 
     # ------------------------------------------------------------- keys
     def _chain(self, tokens: np.ndarray) -> List[Tuple[bytes, np.ndarray]]:
@@ -109,33 +142,57 @@ class PrefixCache:
         return out
 
     # ----------------------------------------------------------- lookup
-    def lookup(self, tokens, touch: bool = True
-               ) -> Tuple[List[int], int]:
-        """Longest cached block-aligned prefix of ``tokens``. Returns
-        ``(pages, matched_len)`` — ``matched_len`` is a multiple of
-        ``page_size`` and ``pages`` the physical pages backing it, in
-        block order. ``touch=False`` is a pure peek (capacity planning):
-        no LRU re-stamp, no hit/miss accounting."""
+    def lookup(self, tokens, touch: bool = True, tiers: bool = False):
+        """Longest cached HBM-RESIDENT block-aligned prefix of
+        ``tokens``. Returns ``(pages, matched_len)`` — ``matched_len``
+        is a multiple of ``page_size`` and ``pages`` the physical pages
+        backing it, in block order. ``touch=False`` is a pure peek
+        (capacity planning): no LRU re-stamp, no hit/miss accounting.
+
+        With a host tier armed a chain can continue past the HBM prefix
+        through demoted entries; those are a miss for THIS splice (their
+        device bytes are gone — the request recomputes the suffix via
+        partial prefill) but ``tiers=True`` returns them as a third
+        element ``(pages, matched_len, demoted)`` so the owner can
+        request an async promote-back — the hash-chain hit on a demoted
+        page the tier turns into a future splice. Touching re-stamps
+        the demoted continuation too: content a request just asked for
+        is the warmest kind, whichever tier holds it."""
         pages: List[int] = []
         matched = 0
         chain: List[_Entry] = []
+        demoted: List[_Entry] = []
         for key, block in self._chain(tokens):
             ent = self._by_key.get(key)
             if ent is None or not np.array_equal(ent.tokens, block):
                 # missing, or a hash collision / stale entry caught by the
                 # verify-on-hit token compare: stop at a miss
                 break
+            if demoted or ent.tier != "hbm":
+                # past the first non-HBM block nothing splices (the
+                # chain must be contiguous from the root); keep walking
+                # only to find what the tier should promote
+                demoted.append(ent)
+                continue
             chain.append(ent)
             pages.append(ent.page)
             matched += self.page_size
         if touch:
-            if chain:
+            if chain or demoted:
                 self._clock += 1
                 for ent in chain:
                     ent.stamp = self._clock
+                for ent in demoted:
+                    ent.stamp = self._clock
+            # a splice-able HBM prefix is a hit; a purely demoted match
+            # is THIS admission's miss (it recomputes), however warm the
+            # host tier is — the tier's own hit counter tells that story
+            if chain:
                 self.hits += 1
             else:
                 self.misses += 1
+        if tiers:
+            return pages, matched, demoted
         return pages, matched
 
     # --------------------------------------------------------- register
@@ -156,6 +213,15 @@ class PrefixCache:
                 # with different tokens must not chain through
                 if not np.array_equal(ent.tokens, block):
                     break
+                if ent.tier != "hbm" and page > 0 \
+                        and page not in self._by_page:
+                    # recompute-as-promote (ISSUE 15): the block's bytes
+                    # were just recomputed onto ``page`` because the
+                    # demoted copy couldn't splice — re-binding the
+                    # entry to the fresh device page IS the promotion,
+                    # minus the copy. The owner_release hook reclaims
+                    # the host slot and orphans any in-flight job.
+                    self._rebind(ent, page)
                 ent.stamp = self._clock
                 parent_ent = ent
                 continue
@@ -193,27 +259,122 @@ class PrefixCache:
     def _remove(self, ent: _Entry):
         del self._by_key[ent.key]
         self._by_page.pop(ent.page, None)
+        # any async tier job for this entry is now stale, and its host
+        # slot (if any) must return to the owner's free list
+        ent.job += 1
+        if self.owner_release is not None:
+            self.owner_release(ent)
         if ent.parent is not None:
             parent = self._by_key.get(ent.parent)
             if parent is not None:
                 parent.children.discard(ent.key)
+
+    def _lru_victim(self, page_ref) -> Optional[_Entry]:
+        """The reclamation victim shared by eviction and demotion: the
+        oldest-stamped HBM entry whose page has refcount 0 and whose
+        cached children (if any) are all off-HBM already — with no tier
+        that degenerates to the classic leaf-first rule, and with one
+        it lets a whole chain drain to the host tail-first without ever
+        stranding a still-spliceable descendant."""
+        victim = None
+        for ent in self._by_key.values():
+            if ent.tier != "hbm" or page_ref[ent.page]:
+                continue
+            if any(self._by_key[k].tier == "hbm" for k in ent.children
+                   if k in self._by_key):
+                continue
+            if victim is None or ent.stamp < victim.stamp:
+                victim = ent
+        return victim
 
     def evict_lru(self, page_ref) -> Optional[int]:
         """Reclaim ONE idle page: the oldest-stamped LEAF entry whose page
         has refcount 0. Returns the freed page id, or None when every
         cached page is either referenced or an interior block. Never
         touches a page any slot still references."""
+        victim = self._lru_victim(page_ref)
+        if victim is None:
+            return None
+        self._remove(victim)
+        self.evictions += 1
+        return victim.page
+
+    # ------------------------------------------------- tier transitions
+    def take_for_demotion(self, page_ref):
+        """Demotion twin of :meth:`evict_lru` (ISSUE 15): pick the same
+        LRU victim, surrender its device page to the caller, but KEEP
+        the entry — ``tier="spilling"`` until the background copy lands
+        in the host slab. Returns ``(page, entry)`` or ``None``. The
+        device-tier eviction counter still ticks: from the paged pool's
+        point of view the page was reclaimed either way."""
+        victim = self._lru_victim(page_ref)
+        if victim is None:
+            return None
+        page = victim.page
+        del self._by_page[page]
+        victim.page = 0
+        victim.tier = "spilling"
+        victim.job += 1
+        self.evictions += 1
+        return page, victim
+
+    def promote(self, ent: _Entry, page: int) -> bool:
+        """Re-bind a host-resident entry to a freshly promoted device
+        page (the owner verified + copied the bytes). False when the
+        entry has meanwhile left the index or the page is already
+        mapped — the owner rolls its copy back."""
+        if self._by_key.get(ent.key) is not ent \
+                or int(page) in self._by_page:
+            return False
+        ent.tier = "hbm"
+        ent.hslot = None
+        ent.job += 1
+        ent.page = int(page)
+        self._by_page[ent.page] = ent
+        # freshly promoted = freshly wanted: re-stamp so the page is not
+        # the very next demotion victim (its old stamp predates the
+        # demotion that parked it)
+        self._clock += 1
+        ent.stamp = self._clock
+        return True
+
+    def _rebind(self, ent: _Entry, page: int):
+        """Recompute-as-promote: re-bind a demoted entry to a device
+        page that just had its exact content recomputed (register's
+        existing-entry path). Ends the entry's host residency — the
+        owner_release hook reclaims the slot and stales the job."""
+        ent.job += 1
+        if self.owner_release is not None:
+            self.owner_release(ent)
+        ent.tier = "hbm"
+        ent.hslot = None
+        ent.page = int(page)
+        self._by_page[ent.page] = ent
+
+    def evict_host_lru(self) -> Optional[_Entry]:
+        """Reclaim ONE host slab slot: drop the oldest host-resident
+        entry with NO cached children in any tier (dropping an interior
+        block would strand descendants the index can still reach).
+        Returns the removed entry (its slot comes back through
+        owner_release) or None."""
         victim = None
         for ent in self._by_key.values():
-            if ent.children or page_ref[ent.page]:
+            if ent.tier != "host" or ent.children:
                 continue
             if victim is None or ent.stamp < victim.stamp:
                 victim = ent
         if victim is None:
             return None
         self._remove(victim)
-        self.evictions += 1
-        return victim.page
+        return victim
+
+    def invalidate_entry(self, ent: _Entry) -> List[int]:
+        """Invalidate-on-doubt for an entry that has no device page to
+        key on (a demoted block whose promotion failed its checksum):
+        same descendants-too walk as :meth:`invalidate_page`."""
+        if self._by_key.get(ent.key) is not ent:
+            return []
+        return self._invalidate_from(ent)
 
     def invalidate_page(self, page: int) -> List[int]:
         """Drop the entry backing ``page`` and every descendant block
@@ -223,19 +384,28 @@ class PrefixCache:
         ent = self._by_page.get(int(page))
         if ent is None:
             return []
+        return self._invalidate_from(ent)
+
+    def _invalidate_from(self, ent: _Entry) -> List[int]:
         stack, dropped = [ent], []
         while stack:
             e = stack.pop()
             stack.extend(self._by_key[k] for k in e.children
                          if k in self._by_key)
             self._remove(e)
-            dropped.append(e.page)
+            if e.page:
+                dropped.append(e.page)
         return dropped
 
     def clear(self) -> List[int]:
         """Flush everything (pool reset / fault recovery). Returns the
-        previously cached pages."""
+        previously cached DEVICE pages (demoted entries have none; their
+        host slots return through owner_release)."""
         pages = list(self._by_page)
+        if self.owner_release is not None:
+            for ent in self._by_key.values():
+                ent.job += 1
+                self.owner_release(ent)
         self._by_key.clear()
         self._by_page.clear()
         return pages

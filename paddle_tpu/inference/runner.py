@@ -194,6 +194,47 @@ class ModelRunner:
         sh = NamedSharding(self.mesh, self.page_spec)
         return [jax.device_put(a, sh) for a in arrays]
 
+    # ------------------------------------------------- host-tier copies
+    # The KV tier's demote/promote dispatches (ISSUE 15). Both are tiny
+    # jitted page-axis gathers/scatters in the _copy_pages idiom: the
+    # page axis is unsharded, so at tp>1 GSPMD runs them over the
+    # lane-sharded pool without a reshard — capture's outputs carry the
+    # lane sharding (device_get in the spill worker assembles the GLOBAL
+    # logical page for the host slab) and restore's donated outputs keep
+    # the pool's NamedSharding, so a tp=N demote/promote round trip
+    # preserves both bytes and layout. Neither ever blocks the engine
+    # thread: capture hands back device handles (the worker does the
+    # one synchronous device->host fetch), restore is a donated async
+    # dispatch whose host->device payload transfer rides the dispatch.
+    # Both take a PADDED page-index vector (pow2, pad slot 0 = the trash
+    # page, the same convention every padded program row uses), so one
+    # dispatch moves a whole demotion/promotion wave and the compile
+    # cache stays one program per pow2 width.
+    @property
+    def capture_pages(self):
+        fn = getattr(self, "_capture_fn", None)
+        if fn is None:
+            import jax
+
+            def _capture(pages_flat, idx):
+                return [b[idx] for b in pages_flat]
+
+            fn = self._capture_fn = jax.jit(_capture)
+        return fn
+
+    @property
+    def restore_pages(self):
+        fn = getattr(self, "_restore_fn", None)
+        if fn is None:
+            import jax
+
+            def _restore(pages_flat, idx, payload):
+                return [b.at[idx].set(x)
+                        for b, x in zip(pages_flat, payload)]
+
+            fn = self._restore_fn = jax.jit(_restore, donate_argnums=0)
+        return fn
+
     # ------------------------------------------------------ weight audit
     def fetch_param_slice(self, i: int, start: int,
                           stop: Optional[int]) -> np.ndarray:

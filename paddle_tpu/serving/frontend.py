@@ -505,7 +505,11 @@ class ServingFrontend:
                         # refuses to mint tokens through corrupt
                         # weights, so step() is a no-op — idle-wait
                         # instead of hot-spinning until the router
-                        # fences this replica and migrates its streams
+                        # fences this replica and migrates its streams.
+                        # The KV host tier drains with the replica
+                        # (ISSUE 15): spill state captured on corrupt
+                        # hardware is never carried into the restart.
+                        eng._cache.shutdown_tier()
                         self._wake.wait(timeout=self._idle_wait_s)
                         self._wake.clear()
                     continue
@@ -519,4 +523,13 @@ class ServingFrontend:
                 self._wake.wait(timeout=self._idle_wait_s)
                 self._wake.clear()
         finally:
+            # every way out of the engine thread — drain, shutdown,
+            # poison (the replica-crash chaos surface), an escape —
+            # stops the KV-tier spill worker too (ISSUE 15): a replica
+            # restart builds a fresh engine, and the dead incarnation
+            # must not keep a live thread queued on its old pool
+            try:
+                eng._cache.shutdown_tier()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
             self._drained.set()

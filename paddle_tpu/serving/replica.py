@@ -227,6 +227,18 @@ class InProcReplica(Replica):
             self._fe.shutdown()
 
     def restart(self):
+        if self._fe is not None:
+            # the dead incarnation's KV host tier dies with it
+            # (ISSUE 15): its spill worker is queued on a pool the
+            # fresh engine will never see, and a quarantined replica's
+            # host copies were captured on hardware the restart exists
+            # to distrust. The frontend's own loop-exit does this too;
+            # a poisoned thread may still be mid-exit, so the
+            # supervisor makes it unconditional (idempotent).
+            try:
+                self._fe.engine._cache.shutdown_tier()
+            except Exception:  # pragma: no cover - teardown best-effort
+                pass
         self._fe = self._factory()
         self._fe.start()
         self.restarts += 1

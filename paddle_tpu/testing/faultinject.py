@@ -85,6 +85,21 @@ failing chaos run replays the exact same flipped bit):
   digest verification can refuse it — ``CheckpointManager.restore``
   must fall back to the newest step that verifies.
 
+KV host-tier points (ISSUE 15 — consulted by the ``kv_tier.HostTier``
+spill worker, the background thread that copies demoted prefix-cache
+pages device→host and back):
+
+* ``kv-spill-corrupt`` — flips one seed-chosen byte of a HOST-resident
+  demoted page right before a promotion reads it, with no doubt signal
+  (host DRAM bit rot). The promote-time blake2b compare against the
+  demotion-time digest must catch it; containment is invalidate +
+  recompute-as-miss — the corrupt bytes never reach the device pool,
+  so detection costs a cache miss, never a token.
+* ``slow-host-copy``  — sleeps ``delay_ms`` (default 25) at the top of
+  each spill-worker job, stretching the demote/promote window: lookups
+  that land inside it must degrade to misses (partial-prefill
+  recompute), never stall the engine thread or deadlock the tier.
+
 Spec grammar (``FLAGS_fault_inject`` / env ``PADDLE_TPU_FAULT_INJECT`` /
 ``Engine(fault_plan=...)``)::
 
@@ -142,6 +157,10 @@ POINTS = (
     "bit-flip-weight",
     "bit-flip-kv",
     "bit-flip-ckpt",
+    # KV host-tier points (ISSUE 15 — consulted ONLY on the spill
+    # worker thread, so chaos replays stay deterministic)
+    "kv-spill-corrupt",
+    "slow-host-copy",
 )
 
 

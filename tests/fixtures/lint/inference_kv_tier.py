@@ -1,0 +1,52 @@
+"""TPL1101 fixtures (kv-tier family, ISSUE 15): synchronous device->
+host transfers of KV PAGE BUFFERS on the scheduling thread vs the
+sanctioned patterns. The file name carries "inference" so the
+path-scoped rule engages, mirroring the other serving-path fixtures."""
+
+import jax
+import numpy as np
+
+
+class Coordinator:
+    def __init__(self):
+        self.k_pages = []
+        self.v_pages = []
+
+    def pages_flat(self):
+        return list(self.k_pages) + list(self.v_pages)
+
+
+def step_fetches_pages(coord, pages_flat, page):
+    # the engine-thread hot path pulling page bytes over the wire
+    raw = jax.device_get(pages_flat[0])  # EXPECT: TPL1101
+    host = np.asarray(coord.k_pages[0][page])  # EXPECT: TPL1101
+    coord.v_pages[0].block_until_ready()  # EXPECT: TPL1101
+    return raw, host
+
+
+def step_fetches_scalars(coord, sum_fn, idx):
+    # clean: the transferred value is a jitted REDUCTION's output (one
+    # scalar per page), not the page bytes — the integrity-checksum
+    # pattern
+    return np.asarray(jax.device_get(sum_fn(coord.pages_flat(), idx)))
+
+
+def step_dispatches_capture(capture, pages_flat, page):
+    # clean: an async gather DISPATCH returns device handles for the
+    # worker; nothing blocks on the scheduling thread
+    return capture(pages_flat, page)
+
+
+def spill_worker_job(handles):
+    # clean: the spill worker is the one sanctioned blocking-fetch site
+    return [np.asarray(jax.device_get(h)) for h in handles]
+
+
+def debug_worker_shim(k_pages):
+    # clean by scope: *worker* functions may fetch page buffers
+    return jax.device_get(k_pages[0])
+
+
+def step_fetch_justified(pages_flat):
+    # a one-off diagnostic dump, justified:
+    return jax.device_get(pages_flat[1])  # tpulint: disable=TPL1101 -- fixture: offline debug dump, not a serving path (EXPECT-SUPPRESSED: TPL1101)

@@ -123,6 +123,9 @@ class TestChunkedIdentity:
         assert eng._pcache.hits >= 4
         assert_pages_conserved(eng)
 
+    # slow: paired chunked/unchunked eos serves; tier-1 wall budget —
+    # still enforced by make chaos
+    @pytest.mark.slow
     def test_eos_identical(self, gpt):
         """eos mid-stream terminates at the same token chunked or not
         (and the chained path's straggler clamp coexists with mixed

@@ -177,6 +177,9 @@ class TestInjectionPoints:
         assert eng._watchdog.level >= NO_SPEC
         assert eng._spec_enabled is False
 
+    # slow: draft-LM drafter build + resync serve; tier-1 wall budget —
+    # still enforced by make chaos
+    @pytest.mark.slow
     def test_draft_model_drafter_fault_resync(self, gpt, clean):
         """Draft-LM drafter faulting intermittently: each fault resets
         its private paged cache, and the next proposal re-syncs every

@@ -211,6 +211,9 @@ class TestVisionZooAdditions:
     """AlexNet / SqueezeNet / DenseNet parity additions (reference:
     python/paddle/vision/models/{alexnet,squeezenet,densenet}.py)."""
 
+    # slow: zoo build cost, tier-1 wall budget; still runs under
+    # make test (the DenseNet case below set the precedent)
+    @pytest.mark.slow
     @pytest.mark.parametrize("builder,size", [
         ("alexnet", 224), ("squeezenet1_1", 224),
     ])

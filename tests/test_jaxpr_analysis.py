@@ -195,6 +195,9 @@ class TestCostModel:
 
 
 class TestToolchain:
+    # slow: duplicates the `make analyze` gate (the full registry sweep
+    # runs there on every make test); tier-1 wall budget
+    @pytest.mark.slow
     def test_registry_sweeps_clean(self):
         """The `make analyze` gate: every registered entry point analyzes
         with ZERO unsuppressed error/warn findings, and any suppression

@@ -121,6 +121,9 @@ def test_1f1b_accepts_non_f32_loss():
     assert np.isfinite(float(jax.device_get(loss._data)))
 
 
+# slow: traces both schedules for the memory compare; tier-1 wall
+# budget — still runs under make test
+@pytest.mark.slow
 def test_1f1b_temp_memory_below_gpipe():
     gpipe = _compiled_temp_bytes("gpipe")
     f1b1 = _compiled_temp_bytes("1F1B")

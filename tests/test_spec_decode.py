@@ -64,6 +64,9 @@ def _assert_tokens_match_tie_aware(model, prompt, got, ref, label=""):
 
 
 class TestGreedyEquivalence:
+    # slow: llama spec-vs-vanilla twin serve; tier-1 wall budget —
+    # still enforced by make chaos
+    @pytest.mark.slow
     def test_ngram_matches_vanilla_engine_llama(self, llama, rng):
         """ISSUE 5 acceptance: greedy spec decode is token-identical to
         the vanilla engine on the tiny llama model (tie-aware)."""
@@ -190,6 +193,9 @@ class TestEosMidBlock:
 
 
 class TestSampling:
+    # slow: sampled spec twin-run determinism; tier-1 wall budget —
+    # still enforced by make chaos
+    @pytest.mark.slow
     def test_sampled_deterministic_seeded(self, gpt, rng):
         """Same seed reproduces under spec decode; different seed
         diverges; everything stays in-vocab."""

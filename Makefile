@@ -25,6 +25,16 @@ analyze:
 	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation \
 		--mesh 1 --mesh 4 --mesh 8
 
+# plan — tpuplan autosharding planner (ISSUE 16): plan every meshable
+#        registry entry at mesh 4 and 8, fail if any entry ends with no
+#        feasible plan, if a chosen plan would cost more than the
+#        hand-written specs under the calibrated model, if any winner
+#        trips the TPC501/502/503 self-audit, or if a plan drifts from
+#        the committed goldens (tests/fixtures/plan/). Gates `test`.
+plan:
+	JAX_PLATFORMS=cpu python tools/plan_tpu.py --mesh 4 --mesh 8 \
+		--fail-on-audit --check-goldens tests/fixtures/plan
+
 chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
 		tests/test_train_resilience.py tests/test_prefix_cache.py \
@@ -65,7 +75,7 @@ serve-smoke:
 		examples/serve_llama_paged.py --tiny --api-port 0 --api-smoke \
 		--multi-step 2 --tenant-weights "interactive=4,batch=1"
 
-test: lint analyze chaos
+test: lint analyze plan chaos
 	python -m pytest tests/ -x -q --ignore=tests/onchip
 
 onchip:
@@ -74,5 +84,5 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: lint analyze chaos chaos-serve chaos-integrity chaos-tier \
+.PHONY: lint analyze plan chaos chaos-serve chaos-integrity chaos-tier \
 	serve-smoke test onchip bench

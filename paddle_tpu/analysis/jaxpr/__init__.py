@@ -47,6 +47,8 @@ from .cost import (CostModelPass, CostRollup, rollup, rollup_fn,  # noqa: F401
 from .sharding import ShardingPass  # noqa: F401
 from .comm import (CommCostPass, CommEstimate, comm_rollup,  # noqa: F401
                    ici_bw, ici_latency, predicted_step_seconds)
+from .planner import (PlanProblem, PlanReport, extract_problem,  # noqa: F401
+                      plan_program)
 from .divergence import check_host_divergence, trace_signature  # noqa: F401
 
 __all__ = [
@@ -60,5 +62,6 @@ __all__ = [
     "ShardingPass",
     "CommCostPass", "CommEstimate", "comm_rollup", "ici_bw", "ici_latency",
     "predicted_step_seconds",
+    "PlanProblem", "PlanReport", "extract_problem", "plan_program",
     "check_host_divergence", "trace_signature",
 ]

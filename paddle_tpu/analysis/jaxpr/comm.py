@@ -52,8 +52,9 @@ from .cost import (DEFAULT_DEVICE_KIND, _cost_op, CostRollup, hbm_bw,
                    peak_flops, _lookup)
 from .liveness import _fmt_bytes
 
-__all__ = ["CommCostPass", "CommEstimate", "comm_rollup",
-           "ICI_BYTES_PER_SEC", "ICI_LATENCY_S", "ici_bw", "ici_latency",
+__all__ = ["CommCostPass", "CommEstimate", "KindTraffic", "comm_kind",
+           "comm_rollup", "ICI_BYTES_PER_SEC", "ICI_LATENCY_S",
+           "ICI_COLLECTIVE_OVERHEAD_S", "ici_bw", "ici_latency",
            "predicted_step_seconds", "collective_cost"]
 
 # ------------------------------------------------------------- ICI tables
@@ -77,6 +78,14 @@ ICI_BYTES_PER_SEC = {
 # per-step (per-hop) collective latency: ~1us on ICI across generations
 ICI_LATENCY_S = 1e-6
 
+# fixed per-collective dispatch/rendezvous overhead on ICI. The host
+# payload sweep (tools/multichip.py, MULTICHIP_r16) measures this term
+# at ~0.5ms on the virtual-CPU mesh; on real ICI the launch+rendezvous
+# cost is a few microseconds. The planner prices device-retargeted
+# plans with this constant so small latency-bound collectives (the
+# decode regime that MULTICHIP_r11 mispredicted 15x) are never free.
+ICI_COLLECTIVE_OVERHEAD_S = 2e-6
+
 
 def ici_bw(device_or_kind) -> float:
     kind = getattr(device_or_kind, "device_kind", device_or_kind) or ""
@@ -89,6 +98,36 @@ def ici_latency(device_or_kind) -> float:
 
 # ------------------------------------------------------------- estimate
 
+# collective primitives grouped into the CALIBRATION kinds the multichip
+# payload sweep fits one overhead-vs-payload curve per (MULTICHIP_r16):
+# the ring algorithm, not the reduction operator, sets the cost shape.
+_KIND_OF = {
+    "psum": "psum", "psum2": "psum", "pmax": "psum", "pmin": "psum",
+    "pmean": "psum",
+    "all_gather": "all_gather", "pgather": "all_gather",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "ppermute",
+}
+
+
+def comm_kind(prim: str) -> str:
+    """Calibration bucket of a collective primitive (``assumed_reshard``
+    and anything unknown keep their own bucket and fall back to the
+    table pricing)."""
+    return _KIND_OF.get(prim, prim)
+
+
+@dataclass
+class KindTraffic:
+    """Per-calibration-kind traffic totals (wire bytes, ring steps and
+    EXECUTED collective count — counts inside a scan are multiplied by
+    the trip count, unlike r11's static count, because each iteration
+    pays the dispatch floor again)."""
+    wire: float = 0.0
+    steps: float = 0.0
+    n: float = 0.0
+
 
 @dataclass
 class CommEstimate:
@@ -97,34 +136,61 @@ class CommEstimate:
     comm_seconds: float = 0.0       # at the device kind it was built for
     overlapped_seconds: float = 0.0
     by_prim: Dict[str, Tuple[float, float]] = field(default_factory=dict)
-    n_collectives: int = 0
+    by_kind: Dict[str, KindTraffic] = field(default_factory=dict)
+    n_collectives: float = 0
     unknown_axes: int = 0           # collectives skipped (axis size unknown)
     device_kind: str = DEFAULT_DEVICE_KIND
 
     def add(self, prim: str, wire: float, steps: float, seconds: float,
-            overlapped: float = 0.0):
+            overlapped: float = 0.0, count: float = 1.0):
         self.wire_bytes += wire
         self.steps += steps
         self.comm_seconds += seconds
         self.overlapped_seconds += min(overlapped, seconds)
         b, s = self.by_prim.get(prim, (0.0, 0.0))
         self.by_prim[prim] = (b + wire, s + seconds)
-        self.n_collectives += 1
+        kt = self.by_kind.setdefault(comm_kind(prim), KindTraffic())
+        kt.wire += wire
+        kt.steps += steps
+        kt.n += count
+        self.n_collectives += count
 
     def seconds_at(self, bw: float, latency: float = ICI_LATENCY_S,
-                   per_collective_s: float = 0.0) -> float:
+                   per_collective_s: float = 0.0,
+                   calibration: Optional[Dict[str, dict]] = None) -> float:
         """Re-price the same traffic under a different link profile (the
         host-calibrated prediction in tools/multichip.py).
 
         ``per_collective_s`` is the measured FIXED overhead each
         collective pays once, independent of ring steps — runtime launch
-        + rendezvous cost. The ISSUE 11 calibration satellite: the tiny-
-        psum latency fit used to fold this whole overhead into the
-        per-step constant, which overpriced many-step rings ~1.27x on
-        the CPU host; splitting intercept from slope brings the TP-step
-        prediction within the ≤1.15x target (MULTICHIP_r11)."""
-        return (self.wire_bytes / max(bw, 1.0) + self.steps * latency
-                + self.n_collectives * per_collective_s)
+        + rendezvous cost. ``calibration`` (MULTICHIP_r16 rework) maps a
+        collective KIND (see :func:`comm_kind`) to its fitted
+        overhead-vs-payload curve ``{"overhead_s", "per_byte_s"}``; kinds
+        present in the table are priced ``n*overhead + wire*per_byte``
+        — NO separate ``steps*latency`` term, because the curve is fit
+        from in-program measurements at the calibration mesh size, so
+        the ring-step latency is already inside the intercept — while
+        absent kinds fall back to the scalar ``bw``/``latency``/
+        ``per_collective_s`` path. The one-point r11 fit priced every
+        collective from a single tiny-psum line, which left the decode
+        regime (many small in-program collectives, each paying the
+        dispatch floor) mispredicted 15x."""
+        if not calibration:
+            return (self.wire_bytes / max(bw, 1.0) + self.steps * latency
+                    + self.n_collectives * per_collective_s)
+        total = 0.0
+        for kind, t in self.by_kind.items():
+            cal = calibration.get(kind)
+            if cal is None:
+                total += (t.wire / max(bw, 1.0) + t.steps * latency
+                          + t.n * per_collective_s)
+            else:
+                per_byte = cal.get("per_byte_s")
+                per_byte = (float(per_byte) if per_byte is not None
+                            else 1.0 / max(bw, 1.0))
+                total += (t.n * float(cal.get("overhead_s", 0.0))
+                          + t.wire * per_byte)
+        return total
 
     @property
     def overlap_fraction(self) -> float:
@@ -160,17 +226,22 @@ def predicted_step_seconds(cost_rollup: Optional[CostRollup],
                            comm_est: Optional["CommEstimate"],
                            peak: float, hbm: float, ici: float,
                            latency: float = ICI_LATENCY_S,
-                           per_collective_s: float = 0.0) -> float:
+                           per_collective_s: float = 0.0,
+                           calibration: Optional[Dict[str, dict]] = None
+                           ) -> float:
     """Compute + comm - overlap under explicit peaks (device tables OR a
     host-calibrated profile). Overlap is scaled with comm: re-pricing
-    the wire keeps the same overlapped *fraction*."""
+    the wire keeps the same overlapped *fraction*. ``calibration`` is
+    the per-collective-kind curve table (see
+    :meth:`CommEstimate.seconds_at`)."""
     compute = 0.0
     if cost_rollup is not None:
         compute = sum(max(f / peak, b / hbm)
                       for f, b in cost_rollup.by_prim.values())
     comm = overlapped = 0.0
     if comm_est is not None:
-        comm = comm_est.seconds_at(ici, latency, per_collective_s)
+        comm = comm_est.seconds_at(ici, latency, per_collective_s,
+                                   calibration=calibration)
         overlapped = min(comm * comm_est.overlap_fraction, compute)
     return compute + comm - overlapped
 
@@ -280,7 +351,7 @@ def _walk(jaxpr_like, sizes: Dict[str, Optional[int]], scale: float,
                          for o in ops[op.index + 1:first]
                          if o.prim not in _COMM_PRIMS)
             est.add(prim, scale * wire, scale * steps, scale * secs,
-                    scale * min(secs, window))
+                    scale * min(secs, window), count=scale)
         elif prim == "sharding_constraint":
             sh = op.params.get("sharding")
             spec = getattr(sh, "spec", None)
@@ -303,7 +374,7 @@ def _walk(jaxpr_like, sizes: Dict[str, Optional[int]], scale: float,
                                                 bw, lat)
             if secs > 0.0:
                 est.add("assumed_reshard", scale * wire, scale * steps,
-                        scale * secs)
+                        scale * secs, count=scale)
 
 
 def _merge(est: CommEstimate, other: CommEstimate) -> None:
@@ -316,6 +387,11 @@ def _merge(est: CommEstimate, other: CommEstimate) -> None:
     for prim, (b, s) in other.by_prim.items():
         pb, ps = est.by_prim.get(prim, (0.0, 0.0))
         est.by_prim[prim] = (pb + b, ps + s)
+    for kind, t in other.by_kind.items():
+        kt = est.by_kind.setdefault(kind, KindTraffic())
+        kt.wire += t.wire
+        kt.steps += t.steps
+        kt.n += t.n
 
 
 def comm_rollup(closed, mesh=None,
@@ -351,7 +427,7 @@ class CommCostPass:
                 R.COMM_BOUND.id, self.name,
                 f"predicted comm {est.comm_seconds * 1e6:.1f}us "
                 f"({_fmt_bytes(int(est.wire_bytes))} over ICI, "
-                f"{est.n_collectives} collectives, overlap "
+                f"{est.n_collectives:g} collectives, overlap "
                 f"{est.overlap_fraction:.0%}) exceeds compute "
                 f"{compute * 1e6:.1f}us on {kind}: ICI-bound at this "
                 f"mesh shape; predicted multichip step "

@@ -898,6 +898,57 @@ class _ModuleAnalyzer:
 
         walk(self.tree, [])
 
+    # -- TPL1201: hard-coded sharding spec literals in serving modules -----
+
+    _SPEC_CTORS = {"PartitionSpec", "NamedSharding"}
+
+    def _spec_ctor_aliases(self) -> Set[str]:
+        """Names this module binds to PartitionSpec/NamedSharding via a
+        sharding-module import (``from jax.sharding import
+        PartitionSpec as P``) — the conventional single-letter alias is
+        only a spec constructor when it was imported as one."""
+        aliases: Set[str] = set()
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ImportFrom) and n.module \
+                    and "sharding" in n.module.split("."):
+                for al in n.names:
+                    if al.name in self._SPEC_CTORS:
+                        aliases.add(al.asname or al.name)
+        return aliases
+
+    def _check_spec_literals(self):
+        """TPL1201 — inference modules only; ``runner.py`` exempt (it IS
+        the canonical spec table the autosharding planner emits into and
+        audits). Any other serving layer constructing a
+        PartitionSpec/NamedSharding inline drifts from the table the
+        first time the plan retargets."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("inference" in p for p in parts):
+            return
+        if os.path.basename(self.path) == "runner.py":
+            return
+        aliases = self._spec_ctor_aliases()
+        for n in ast.walk(self.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            ctor = None
+            if isinstance(fn, ast.Name) and fn.id in aliases:
+                ctor = fn.id
+            else:
+                dotted = _dotted(fn)
+                tail = dotted.split(".")[-1] if dotted else ""
+                if tail in self._SPEC_CTORS:
+                    ctor = tail
+            if ctor:
+                self._add(
+                    R.HARDCODED_SPEC_LITERAL, n,
+                    f"inline {ctor} in a serving module outside the "
+                    "canonical spec table (inference/runner.py); import "
+                    "the spec from ModelRunner's table or thread it "
+                    "through as an argument so the planner's retargets "
+                    "reach this layer")
+
     # -- TPL702: direct writes to checkpoint paths -------------------------
 
     _CKPT_PATH_HINTS = ("ckpt", "checkpoint", "step-")
@@ -1214,6 +1265,7 @@ class _ModuleAnalyzer:
         self._check_error_handling()
         self._check_integrity_handling()
         self._check_page_host_sync()
+        self._check_spec_literals()
         self._check_ckpt_writes()
         self._check_multihost_divergence()
         self._check_async_blocking()

@@ -264,4 +264,19 @@ SWALLOWED_INTEGRITY_ERROR = _rule(
     "state, or fail the request with its `integrity` reason.")
 
 
+HARDCODED_SPEC_LITERAL = _rule(
+    "TPL1201", "planner", "hardcoded-spec-literal",
+    "a PartitionSpec (`P(...)`) or NamedSharding constructed inline in "
+    "a paddle_tpu/inference/ module outside runner.py's canonical spec "
+    "table. The serving stack has exactly one source of sharding truth "
+    "— ModelRunner's spec table, which the autosharding planner "
+    "(tools/plan_tpu.py) emits and audits — and a literal spec in any "
+    "other serving layer is drift waiting to happen: the planner can "
+    "prove the table's plan optimal and TPC501/502/503-clean, but it "
+    "cannot see a spec hard-coded past it, so the first retarget "
+    "(--device/--mesh) silently leaves that layer sharded for the old "
+    "topology. Import the spec from the runner's table (or thread it "
+    "through as an argument) instead of constructing it in place.")
+
+
 FAMILIES = sorted({r.family for r in RULES.values()})

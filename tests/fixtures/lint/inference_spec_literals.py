@@ -1,0 +1,51 @@
+"""TPL1201 fixture — hard-coded sharding spec literals in a serving
+module. The file name carries "inference" so the path-scoped planner
+family engages (the rule exempts ``runner.py``, the canonical spec
+table the autosharding planner emits into).
+"""
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _mesh():
+    return None
+
+
+# -- violations: inline spec construction outside the runner table ------
+
+
+def route_kv_pool(mesh):
+    spec = P(None, None, "tp")  # EXPECT: TPL1201
+    return NamedSharding(mesh, spec)  # EXPECT: TPL1201
+
+
+def place_logits(mesh):
+    import jax
+
+    return jax.sharding.NamedSharding(  # EXPECT: TPL1201
+        mesh, replicated_spec())
+
+
+# -- suppressed: a justified one-off --------------------------------------
+
+
+def debug_spec_repr(mesh):
+    return P("tp")  # tpulint: disable=TPL1201 -- fixture: offline debug dump of the active plan, never installed on a live array (EXPECT-SUPPRESSED: TPL1201)
+
+
+# -- clean: specs come FROM the canonical table, not from literals --------
+
+
+def replicated_spec():
+    from paddle_tpu.inference.runner import ModelRunner
+
+    return ModelRunner.spec_table()["replicated"]
+
+
+def shard_with_table_spec(runner, name):
+    # threading the runner's own table through is the sanctioned path
+    return runner.spec_table()[name]
+
+
+def spec_passthrough(spec, mesh):
+    # constructing nothing: placement with a spec handed in is fine
+    return {"spec": spec, "mesh": mesh}

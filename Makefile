@@ -39,9 +39,9 @@ chaos:
 	JAX_PLATFORMS=cpu python -m pytest tests/test_fault_tolerance.py \
 		tests/test_train_resilience.py tests/test_prefix_cache.py \
 		tests/test_chunked_prefill.py tests/test_tp_serving.py \
-		tests/test_multi_step.py tests/test_api_server.py \
-		tests/test_replica_failover.py tests/test_integrity.py \
-		tests/test_kv_tier.py -q
+		tests/test_moe_serving.py tests/test_multi_step.py \
+		tests/test_api_server.py tests/test_replica_failover.py \
+		tests/test_integrity.py tests/test_kv_tier.py -q
 
 # chaos-serve — the multi-replica failover suite alone (ISSUE 13):
 # SIGKILL/poison a replica mid-stream, assert every client stream

@@ -397,6 +397,20 @@ def bench_kv_tier(cfg, on_tpu):
         return {"kv_tier_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_moe(cfg, on_tpu):
+    """Expert-parallel MoE serving scenario (ISSUE 17): tiny-MoE decode
+    tokens/s (8 experts, top-2, grouped-expert Pallas FFN, capacity
+    drops) vs the equal-active-params dense twin — interleaved-rep
+    medians over the 50 ms jitter floor, gate: dense/MoE <= 1.5x — plus
+    the router's drop fraction and per-expert load imbalance."""
+    try:
+        from paddle_tpu.inference.engine import bench_moe_serving
+
+        return bench_moe_serving(cfg, on_tpu)
+    except Exception as e:
+        return {"moe_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_slo(cfg, on_tpu):
     """Serving-front-end SLO scenario (ISSUE 12): multi-step decode
     speedup (multi_step=4 >= 1.2x multi_step=1), an open-loop Poisson
@@ -715,6 +729,7 @@ def main():
     fault = bench_fault(decode_cfg, on_tpu)
     prefix = bench_prefix(decode_cfg, on_tpu)
     kv_tier = bench_kv_tier(decode_cfg, on_tpu)
+    moe = bench_moe(decode_cfg, on_tpu)
     slo = bench_slo(decode_cfg, on_tpu)
     failover = bench_failover(decode_cfg, on_tpu)
     integrity = bench_integrity(decode_cfg, on_tpu)
@@ -793,6 +808,17 @@ def main():
         "kv_tier_hit_rate_off": kv_tier.get("kv_tier_hit_rate_off", 0.0),
         "kv_tier_prefill_ratio": kv_tier.get(
             "kv_tier_prefill_ratio", 0.0),
+        # expert-parallel MoE serving surface (ISSUE 17): the router's
+        # registry counters across the run (capacity drops, per-expert
+        # load spread) beside the MoE block's own throughput gate
+        "moe_tokens_dropped": int(
+            metric_total("paddle_tpu_moe_tokens_dropped_total")),
+        "moe_expert_tokens": int(
+            metric_total("paddle_tpu_moe_expert_tokens_total")),
+        "moe_drop_frac": moe.get("moe_drop_frac", 0.0),
+        "moe_load_imbalance": moe.get("moe_load_imbalance", 0.0),
+        "moe_dense_over_moe_ratio": moe.get(
+            "moe_dense_over_moe_ratio", 0.0),
         # decode hot-path kernel surface (ISSUE 9): prompt chunks
         # streamed through mixed steps, and fused-slab-path dispatches
         # across the three consumers (verify / suffix / chunked)
@@ -890,6 +916,7 @@ def main():
         **fault,
         **prefix,
         **kv_tier,
+        **moe,
         **slo,
         **failover,
         **integrity,

@@ -18,14 +18,19 @@ import sys as _sys
 
 _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), ".."))
 
-# --tp N on a CPU host needs N virtual devices BEFORE jax initializes
-# (same trick as tests/conftest.py); a real TPU slice has real chips
-if "--tp" in _sys.argv and "xla_force_host_platform_device_count" not in \
+# --tp N / --ep M on a CPU host needs N*M virtual devices BEFORE jax
+# initializes (same trick as tests/conftest.py); a real slice has real chips
+if ("--tp" in _sys.argv or "--ep" in _sys.argv) and \
+        "xla_force_host_platform_device_count" not in \
         _os.environ.get("XLA_FLAGS", ""):
-    try:
-        _n = max(2, int(_sys.argv[_sys.argv.index("--tp") + 1]))
-    except (ValueError, IndexError):
-        _n = 8
+    def _degree(flag):
+        if flag not in _sys.argv:
+            return 1
+        try:
+            return max(1, int(_sys.argv[_sys.argv.index(flag) + 1]))
+        except (ValueError, IndexError):
+            return 8
+    _n = max(2, _degree("--tp") * _degree("--ep"))
     _os.environ["XLA_FLAGS"] = (_os.environ.get("XLA_FLAGS", "")
                                 + f" --xla_force_host_platform_device_count={_n}").strip()
 
@@ -201,6 +206,28 @@ def main():
                          "virtual-device mesh (the harness forces 8); "
                          "on a TPU slice it shards over real chips. "
                          "tp must divide num_heads/num_kv_heads")
+    ap.add_argument("--ep", type=int, default=None,
+                    help="expert-parallel degree (ISSUE 17, implies "
+                         "--moe): shard the MoE expert weights over an "
+                         "ep-way mesh axis — routing stays replicated "
+                         "(every shard routes all tokens, so output "
+                         "tokens are identical to --ep 1), only the "
+                         "expert FFN is distributed: one all_to_all "
+                         "dispatch + one all_gather combine per MoE "
+                         "layer. Composes with --tp (devices reshape to "
+                         "tp x ep). ep must divide num_experts")
+    ap.add_argument("--moe", action="store_true",
+                    help="serve the MoE twin of the model (ISSUE 17): "
+                         "8 experts, top-2 routing, grouped-expert "
+                         "Pallas FFN, capacity-factor token dropping")
+    ap.add_argument("--capacity-factor", type=float, default=None,
+                    help="MoE per-expert token budget factor (ISSUE "
+                         "17): each expert accepts at most C = ceil(cf "
+                         "* top_k * T / E) tokens per dispatch; "
+                         "overflow pairs drop (combine renormalizes "
+                         "over the survivors) — overload degrades "
+                         "quality, never OOMs or recompiles. Default "
+                         "from the model config (1.25)")
     ap.add_argument("--disaggregate", action="store_true",
                     help="prefill/decode role separation (ISSUE 11, "
                          "needs --prefill-chunk): mid-prompt slots "
@@ -320,9 +347,21 @@ def main():
               flush=True)
 
     paddle.seed(0)
-    cfg = tiny_llama_config() if args.tiny else tiny_llama_config(
-        hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=4,
-        intermediate_size=512, max_position=512)
+    moe = args.moe or (args.ep or 0) > 1 or args.capacity_factor is not None
+    if moe:
+        from paddle_tpu.models.llama import tiny_moe_llama_config
+
+        # expert FF width = intermediate/top_k keeps active params per
+        # token equal to the dense config it replaces
+        cfg = tiny_moe_llama_config() if args.tiny else \
+            tiny_moe_llama_config(
+                hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=4,
+                intermediate_size=512, max_position=512,
+                moe_intermediate_size=256)
+    else:
+        cfg = tiny_llama_config() if args.tiny else tiny_llama_config(
+            hidden_size=256, num_layers=4, num_heads=8, num_kv_heads=4,
+            intermediate_size=512, max_position=512)
     model = LlamaForCausalLM(cfg)
     model.eval()
     if args.weight_quant != "none":
@@ -357,12 +396,14 @@ def main():
                  prefix_cache=args.prefix_cache == "on",
                  kv_host_pages=args.kv_host_pages,
                  prefill_chunk=args.prefill_chunk,
-                 tp=args.tp, disaggregate=args.disaggregate,
+                 tp=args.tp, ep=args.ep,
+                 capacity_factor=args.capacity_factor,
+                 disaggregate=args.disaggregate,
                  multi_step=args.multi_step,
                  integrity=None if args.integrity == "off"
                  else args.integrity)
     if eng.runner.sharded:
-        print(f"tensor parallel: tp={eng.runner.tp} over "
+        print(f"sharded: tp={eng.runner.tp} ep={eng.runner.ep} over "
               f"{[str(d) for d in eng.runner.mesh.devices.flat]}")
 
     if args.api_port is not None:
@@ -419,6 +460,15 @@ def main():
               f"{t.host_pages - len(t._free_hslots)}/{t.host_pages} "
               "host pages resident")
         eng._cache.shutdown_tier()
+    ms = eng.moe_stats()
+    if ms:
+        print(f"moe[ep={eng.runner.ep}] {cfg.num_experts} experts "
+              f"top-{cfg.moe_top_k}: "
+              f"{int(ms['pairs_dropped'])} dropped / "
+              f"{int(ms['pairs_kept']) + int(ms['pairs_dropped'])} routed "
+              f"pairs (drop_frac {ms['drop_frac']:.3f}), "
+              f"load imbalance {ms['load_imbalance']:.2f}x, "
+              f"router entropy {ms['router_entropy']:.2f} nats")
     if eng._spec is not None:
         s = eng._spec.stats()
         print(f"spec[{s['drafter']}] k={s['k']}: "

@@ -949,6 +949,50 @@ class _ModuleAnalyzer:
                     "through as an argument so the planner's retargets "
                     "reach this layer")
 
+    # -- TPL1301: per-expert matmul dispatch loops -------------------------
+
+    _DISPATCH_TAILS = {"matmul", "dot", "dot_general", "einsum"}
+
+    def _check_expert_loop_dispatch(self):
+        """TPL1301 — inference/ops modules only. A Python ``for`` over a
+        ``range(...)`` whose bound names an expert axis, with a
+        matmul/dot/einsum call in the body, dispatches one kernel per
+        expert: E launches + E weight streams per MoE layer, unrolled at
+        trace time into E separate dots XLA will not re-fuse. The
+        grouped-expert kernel exists so this shape never ships."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("inference" in p or p == "ops" for p in parts):
+            return
+        for loop in ast.walk(self.tree):
+            if not isinstance(loop, ast.For):
+                continue
+            it = loop.iter
+            if not (isinstance(it, ast.Call)
+                    and isinstance(it.func, ast.Name)
+                    and it.func.id == "range"):
+                continue
+            bound_toks = " ".join(
+                self._path_expr_tokens(a) for a in it.args)
+            if "expert" not in bound_toks:
+                continue
+            dispatch = None
+            for n in ast.walk(loop):
+                if isinstance(n, ast.Call):
+                    dotted = _dotted(n.func)
+                    tail = dotted.split(".")[-1] if dotted else ""
+                    if tail in self._DISPATCH_TAILS:
+                        dispatch = tail
+                        break
+            if dispatch is None:
+                continue
+            self._add(
+                R.PER_EXPERT_DISPATCH_LOOP, loop,
+                f"`for` over an expert axis ({ast.unparse(it)}) issuing "
+                f"one `{dispatch}` per expert; sort (token, choice) "
+                "pairs by expert and stream all experts through "
+                "paddle_tpu.ops.pallas.grouped_matmul in one fused "
+                "kernel")
+
     # -- TPL702: direct writes to checkpoint paths -------------------------
 
     _CKPT_PATH_HINTS = ("ckpt", "checkpoint", "step-")
@@ -1266,6 +1310,7 @@ class _ModuleAnalyzer:
         self._check_integrity_handling()
         self._check_page_host_sync()
         self._check_spec_literals()
+        self._check_expert_loop_dispatch()
         self._check_ckpt_writes()
         self._check_multihost_divergence()
         self._check_async_blocking()

@@ -279,4 +279,18 @@ HARDCODED_SPEC_LITERAL = _rule(
     "through as an argument) instead of constructing it in place.")
 
 
+PER_EXPERT_DISPATCH_LOOP = _rule(
+    "TPL1301", "moe", "per-expert-dispatch-loop",
+    "a Python `for` loop over an expert axis dispatching one matmul/"
+    "dot/einsum per expert in a paddle_tpu/inference/ or paddle_tpu/"
+    "ops/ module. Per-expert dispatch costs E kernel launches and E "
+    "weight-stream setups per MoE layer, and at trace time it unrolls "
+    "into E separate XLA dots the compiler will not re-fuse — the "
+    "exact traffic pattern the grouped-expert kernel exists to avoid. "
+    "Sort the (token, choice) pairs by expert into contiguous row "
+    "groups and stream ALL experts' weights through ONE fused kernel: "
+    "`paddle_tpu.ops.pallas.grouped_matmul` (ragged_dot semantics, "
+    "f32 accumulation, capacity-padding aware via valid_sizes).")
+
+
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -181,6 +181,7 @@ LLaMA both qualify.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from dataclasses import dataclass, field
@@ -264,6 +265,25 @@ def _pow2ceil(n: int) -> int:
     return p
 
 
+@contextlib.contextmanager
+def _moe_tap(n: int):
+    """Arm the MoE router-stats tap around ONE ``model.forward`` when
+    the engine serves an MoE config (``n`` = stats width,
+    ``moe_stats_size(cfg)``; 0 = dense engine, no-op). Yields the
+    per-layer stats list the MoE layers append to (traced arrays — the
+    raw program sums them into its trailing stats output)."""
+    # tpulint: disable=TPL301 -- n is a static Python int (the config's
+    # stats width, fixed at program-build time), never a tracer; the
+    # branch selects program STRUCTURE (dense vs MoE), not a data path
+    if not n:
+        yield None
+        return
+    from ..models.llama import moe_stats_tap
+
+    with moe_stats_tap() as tap:
+        yield tap
+
+
 def make_mixed_step_fn(engine, sampling):
     """Build the raw mixed chunk+decode step (ISSUE 9 tentpole b) — the
     fixed-shape program ``Engine(prefill_chunk=)`` dispatches every
@@ -286,6 +306,7 @@ def make_mixed_step_fn(engine, sampling):
     same raw function (``tools/analyze_tpu.py`` entry
     ``chunked_prefill_step``)."""
     model = engine.model
+    moe_n = getattr(engine, "_moe_stats_n", 0)
 
     def mixed_chunk_step(params, pages_flat, ids, widths, emit, tables,
                          lengths, temps, keys):
@@ -295,8 +316,9 @@ def make_mixed_step_fn(engine, sampling):
             states = engine._states_from(pages_flat, tables, lengths,
                                          prefill_valid=widths,
                                          verify=True)
-            logits, new_states = model.forward(Tensor._wrap(ids),
-                                               caches=states)
+            with _moe_tap(moe_n) as tap:
+                logits, new_states = model.forward(Tensor._wrap(ids),
+                                                   caches=states)
             lg = logits._data if isinstance(logits, Tensor) else logits
             last = jnp.take_along_axis(
                 lg, (widths - 1)[:, None, None], axis=1)[:, 0]
@@ -310,7 +332,10 @@ def make_mixed_step_fn(engine, sampling):
                 new_keys = jnp.where((emit > 0)[:, None], burned, keys)
             else:
                 tok, new_keys = greedy, keys
-            return tok, new_keys, bad, engine._pages_of(new_states)
+            out = tok, new_keys, bad, engine._pages_of(new_states)
+            if moe_n:
+                out += (jnp.sum(jnp.stack(tap), axis=0),)
+            return out
 
     return mixed_chunk_step
 
@@ -476,6 +501,24 @@ class _EngineMetrics:
         # dispatched the fused verify/suffix slab program (the label
         # mirrors the three consumers: spec verify, prefix-cache suffix
         # prefill, chunked prefill)
+        # expert-parallel MoE serving surface (ISSUE 17): capacity-drop
+        # pressure, per-expert routing load (bounded labels), and the
+        # router's distribution entropy (collapse detector: uniform
+        # routing sits at ln(num_experts), a collapsed router near 0)
+        self.moe_dropped = counter(
+            "paddle_tpu_moe_tokens_dropped_total",
+            "(token, expert-choice) pairs dropped by the capacity "
+            "factor; combine weights renormalize over the survivors")
+        self.moe_expert_tokens = counter(
+            "paddle_tpu_moe_expert_tokens_total",
+            "routed (token, choice) pairs kept per expert (bounded "
+            "cardinality: experts past the cap share 'other')",
+            labelnames=("expert",))
+        self.moe_router_entropy = gauge(
+            "paddle_tpu_moe_router_entropy_nats",
+            "mean router-distribution entropy of the most recently "
+            "drained MoE dispatches")
+        self._moe_expert_children: Dict[int, object] = {}
         self.prefill_chunks = counter(
             "paddle_tpu_prefill_chunks_total",
             "prompt chunks admitted into the mixed chunk+decode step")
@@ -536,6 +579,15 @@ class _EngineMetrics:
         self._qwait_children: Dict[str, object] = {}
 
     _TENANT_CAP = 24  # distinct tenant label values before "other"
+    _EXPERT_CAP = 32  # distinct expert label values before "other"
+
+    def moe_expert_at(self, e: int):
+        child = self._moe_expert_children.get(e)
+        if child is None:
+            label = str(e) if e < self._EXPERT_CAP else "other"
+            child = self.moe_expert_tokens.labels(expert=label)
+            self._moe_expert_children[e] = child
+        return child
 
     def chain_depth_at(self, k: int):
         child = self._depth_children.get(k)
@@ -606,7 +658,9 @@ class Engine:
                  fault_plan=None, watchdog: Optional[dict] = None,
                  prefix_cache: bool = False, kv_host_pages: int = 0,
                  prefill_chunk: Optional[int] = None,
-                 tp: Optional[int] = None, disaggregate: bool = False,
+                 tp: Optional[int] = None, ep: Optional[int] = None,
+                 capacity_factor: Optional[float] = None,
+                 disaggregate: bool = False,
                  multi_step: int = 1, integrity=None):
         cfg = model.config
         self.model = model
@@ -627,14 +681,40 @@ class Engine:
         self.quantized = bool(quantized_cache)
         self.max_pages_per_seq = cfg.max_position // page_size
         self.num_pages = num_pages
+        # expert-parallel MoE serving (ISSUE 17): an MoE config grows
+        # every compiled program ONE trailing router-stats output
+        # (per-expert kept counts, capacity drops, entropy — see
+        # models.llama.moe_stats_size); _moe_pending holds undrained
+        # device handles, _moe_tot the cumulative host aggregate.
+        n_exp = int(getattr(cfg, "num_experts", 0) or 0)
+        self._moe_stats_n = (n_exp + 3) if n_exp else 0
+        self._moe_pending: List = []
+        self._moe_tot = np.zeros((self._moe_stats_n,), np.float64)
+        if capacity_factor is not None:
+            if not n_exp:
+                raise ValueError(
+                    "capacity_factor= on a dense model: the capacity "
+                    "factor sizes each expert's token buffer — serve an "
+                    "MoE config or drop the knob")
+            cf = float(capacity_factor)
+            if cf <= 0:
+                raise ValueError(
+                    f"capacity_factor={cf} must be > 0 (it scales the "
+                    "per-expert token capacity ceil(cf*k*T/E))")
+            # host-side override BEFORE any trace: capacity is a static
+            # shape input, so changing it later would silently recompile
+            for lyr in model.sublayers(include_self=True):
+                if hasattr(lyr, "router") and hasattr(lyr, "experts_gate"):
+                    lyr.capacity_factor = cf
         # model-runner (ISSUE 11 tentpole): owns the compiled programs
-        # and — at tp>1 — the tensor-parallel mesh they trace under
-        # (weights column/row-sharded, KV pool head-sharded, host
-        # operands replicated; one shard_map per dispatch). The
-        # scheduler below stays device-count-agnostic.
+        # and — at tp>1 / ep>1 — the mesh they trace under (weights
+        # column/row-sharded over tp, stacked expert weights sharded
+        # over ep, KV pool head-sharded, host operands replicated; one
+        # shard_map per dispatch). The scheduler below stays
+        # device-count-agnostic.
         from .runner import ModelRunner
 
-        self.runner = ModelRunner(self, tp)
+        self.runner = ModelRunner(self, tp, ep)
         # compiled-program shapes quantize to this (watchdog batch
         # shrink must keep slot caps mesh-aligned — ISSUE 11 satellite)
         self._batch_quantum = self.runner.tp if self.runner.sharded else 1
@@ -1488,6 +1568,7 @@ class Engine:
         cache-off path, so zero-overlap traffic never pays for the
         cache."""
         model, engine = self.model, self
+        moe_n = self._moe_stats_n
 
         def prefill(params, pages_flat, ids, valid, tables_rows,
                     lengths_rows, temps, keys):
@@ -1498,8 +1579,9 @@ class Engine:
                                              lengths_rows,
                                              prefill_valid=valid,
                                              verify=suffix)
-                logits, new_states = model.forward(Tensor._wrap(ids),
-                                                   caches=states)
+                with _moe_tap(moe_n) as tap:
+                    logits, new_states = model.forward(Tensor._wrap(ids),
+                                                       caches=states)
                 lg = logits._data if isinstance(logits, Tensor) else logits
                 last = jnp.take_along_axis(
                     lg, (valid - 1)[:, None, None], axis=1)[:, 0]
@@ -1514,7 +1596,10 @@ class Engine:
                                                          temps, keys)
                 else:
                     tok, new_keys = greedy, keys
-                return tok, new_keys, bad, engine._pages_of(new_states)
+                out = tok, new_keys, bad, engine._pages_of(new_states)
+                if moe_n:
+                    out += (jnp.sum(jnp.stack(tap), axis=0),)
+                return out
 
         return prefill
 
@@ -1532,6 +1617,7 @@ class Engine:
         property the sharded chain is gated on)."""
         model, engine = self.model, self
         steps = k * self.chunk_size
+        moe_n = self._moe_stats_n
 
         def decode_chain(params, pages_flat, tables, lengths, last_tok,
                          temps, keys):
@@ -1539,10 +1625,16 @@ class Engine:
 
             with swapped_tensors(engine._swap, params), pause_tape():
                 def body(carry, _):
-                    pages_flat, lengths, last, keys, bad = carry
+                    pages_flat, lengths, last, keys, bad, mstat = carry
                     states = engine._states_from(pages_flat, tables, lengths)
-                    logits, new_states = model.forward(
-                        Tensor._wrap(last[:, None]), caches=states)
+                    # the tap must arm INSIDE the scan body — its traced
+                    # stats belong to this iteration; they fold into the
+                    # carry accumulator, never escape the body
+                    with _moe_tap(moe_n) as tap:
+                        logits, new_states = model.forward(
+                            Tensor._wrap(last[:, None]), caches=states)
+                    if moe_n:
+                        mstat = mstat + jnp.sum(jnp.stack(tap), axis=0)
                     lg = (logits._data if isinstance(logits, Tensor)
                           else logits)
                     lg = lg[:, -1].astype(jnp.float32)
@@ -1557,13 +1649,20 @@ class Engine:
                         nxt = greedy
                     # idle slots keep emitting garbage; host discards
                     return ((engine._pages_of(new_states),
-                             new_states[0].lengths, nxt, keys, bad), nxt)
+                             new_states[0].lengths, nxt, keys, bad,
+                             mstat), nxt)
 
-                (pages_flat, lengths, _, keys, bad), toks = jax.lax.scan(
-                    body, (pages_flat, lengths, last_tok, keys,
-                           jnp.zeros(last_tok.shape, bool)), None,
-                    length=steps)
-            return jnp.swapaxes(toks, 0, 1), pages_flat, lengths, keys, bad
+                (pages_flat, lengths, _, keys, bad, mstat), toks = \
+                    jax.lax.scan(
+                        body, (pages_flat, lengths, last_tok, keys,
+                               jnp.zeros(last_tok.shape, bool),
+                               jnp.zeros((moe_n,), jnp.float32)), None,
+                        length=steps)
+            out = (jnp.swapaxes(toks, 0, 1), pages_flat, lengths, keys,
+                   bad)
+            if moe_n:
+                out += (mstat,)
+            return out
 
         return decode_chain
 
@@ -1730,13 +1829,81 @@ class Engine:
             keys[i] = req._key
         prefill = self._get_prefill((nb, seq_bucket),
                                     bool(np.any(temps > 0.0)), suffix_mode)
-        tok, new_keys, bad, pages_flat = prefill(
+        tok, new_keys, bad, pages_flat, *ex = prefill(
             self._params, self._pages_flat(), jnp.asarray(ids),
             jnp.asarray(valid), jnp.asarray(tables),
             jnp.asarray(bases), jnp.asarray(temps),
             jnp.asarray(keys))
         self._set_pages(pages_flat)
+        self._note_moe_stats(ex)
         return tok, new_keys, bad
+
+    # ------------------------------------------ MoE router stats (ISSUE 17)
+    def _note_moe_stats(self, ex):
+        """Stash the trailing router-stats device handle an MoE
+        program's dispatch returned (``ex`` is the splat-captured tail —
+        empty on dense engines). Non-blocking; drained at the step
+        boundary / :meth:`moe_stats`. The soft cap bounds growth when a
+        caller dispatches outside ``step()`` (e.g. blocking admission
+        in a tight loop) — by then the producing program's sibling
+        outputs were fetched, so the drain's ``device_get`` is cheap."""
+        if ex:
+            self._moe_pending.append(ex[0])
+            if len(self._moe_pending) > 64:
+                self._drain_moe_stats()
+
+    def _drain_moe_stats(self):
+        """Fold pending router-stats vectors into the host aggregate and
+        record the MoE metrics (HOST code between dispatches — TPL601)."""
+        if not self._moe_pending:
+            return
+        pend, self._moe_pending = self._moe_pending, []
+        try:
+            vals = jax.device_get(tuple(pend))
+        except Exception:  # tpulint: disable=TPL701 -- observability drain: the producing step's OWN harvest already routed this failure through _recover_step_fault; the stats sibling dying with it is the recovery contract, and a metrics drain must never take down the scheduler
+            return
+        agg = np.zeros_like(self._moe_tot)
+        for v in vals:
+            agg += np.asarray(v, np.float64)
+        self._moe_tot += agg
+        if self._m is not None:
+            e = self._moe_stats_n - 3
+            if agg[e]:
+                self._m.moe_dropped.inc(float(agg[e]))
+            for i in range(e):
+                if agg[i]:
+                    self._m.moe_expert_at(i).inc(float(agg[i]))
+            routed = float(agg[e + 2])
+            if routed > 0:
+                self._m.moe_router_entropy.set(float(agg[e + 1]) / routed)
+
+    def moe_stats(self) -> Dict[str, object]:
+        """Cumulative MoE routing stats since engine construction
+        (bench.py's metrics tail and serve_llama_paged's stats line read
+        this). ``{}`` on dense engines. ``drop_frac`` is dropped pairs /
+        total routed pairs (kept + dropped); ``load_imbalance`` is
+        max/mean over the per-expert kept counts (1.0 = perfectly
+        balanced); ``router_entropy`` is the per-token mean in nats."""
+        if not self._moe_stats_n:
+            return {}
+        self._drain_moe_stats()
+        e = self._moe_stats_n - 3
+        t = self._moe_tot
+        load = t[:e]
+        kept = float(load.sum())
+        dropped = float(t[e])
+        pairs = kept + dropped
+        routed = float(t[e + 2])
+        mean = kept / e if e else 0.0
+        return {
+            "tokens_routed": routed,
+            "pairs_kept": kept,
+            "pairs_dropped": dropped,
+            "drop_frac": dropped / pairs if pairs else 0.0,
+            "expert_load": [float(x) for x in load],
+            "load_imbalance": float(load.max()) / mean if mean > 0 else 0.0,
+            "router_entropy": float(t[e + 1]) / routed if routed else 0.0,
+        }
 
     def _flush_cow(self):
         """Flush pending copy-on-write page duplications in one device
@@ -2215,12 +2382,13 @@ class Engine:
         self._flush_cow()
         sampling = bool(np.any(temps_c > 0.0))
         mixed = self._get_mixed(nb, sampling)
-        tok_d, keys_d, bad_d, pages = mixed(
+        tok_d, keys_d, bad_d, pages, *ex = mixed(
             self._params, self._pages_flat(), jnp.asarray(ids),
             jnp.asarray(widths), jnp.asarray(emit),
             jnp.asarray(tables_c), jnp.asarray(lengths_c),
             jnp.asarray(temps_c), jnp.asarray(keys_c))
         self._set_pages(pages)
+        self._note_moe_stats(ex)
         return slots, widths, tok_d, keys_d, bad_d
 
     def _mixed_harvest(self, slots, widths, tok, keys_h, bad_h):
@@ -2295,11 +2463,12 @@ class Engine:
         keys_c[:n] = self._keys[slots]
         sampling = bool(np.any(temps_c > 0.0))
         decode = self._get_decode(nb, k, sampling)
-        toks_d, pages, lengths_d, keys_d, bad_d = decode(
+        toks_d, pages, lengths_d, keys_d, bad_d, *ex = decode(
             self._params, self._pages_flat(), jnp.asarray(tables_c),
             jnp.asarray(lengths_c), jnp.asarray(last_c),
             jnp.asarray(temps_c), jnp.asarray(keys_c))
         self._set_pages(pages)
+        self._note_moe_stats(ex)
         return (slots, slot_reqs, toks_d, lengths_d, keys_d, bad_d)
 
     def _chain_harvest(self, slots, slot_reqs, toks, lengths_h, keys_h,
@@ -2486,6 +2655,11 @@ class Engine:
                 self._integrity.on_step()
         except Exception as e:
             self._recover_step_fault(e)
+        if self._moe_stats_n:
+            # router-stats handles fold at the step boundary: their
+            # producing programs were fenced by the harvest above, so
+            # this never blocks on in-flight compute
+            self._drain_moe_stats()
         if self._m is not None:
             self._m.steps_per_roundtrip.observe(batched)
             self._m.step_seconds.observe(time.perf_counter() - t0)
@@ -2523,6 +2697,9 @@ class Engine:
             if not req.done:
                 self._requeue(req)
         self._pending_inflight = []
+        # router-stats handles of the failed step's dispatches are dead
+        # with their programs; the requeued work re-counts on recompute
+        self._moe_pending = []
         self._reset_pool()
 
     def _chained_step(self, t0):
@@ -2588,11 +2765,12 @@ class Engine:
             # the whole chain is ONE compiled scan: one dispatch; the ONLY
             # blocking fetch of the step happens below and covers the
             # prefill results too
-            toks_d, pages, lengths_d, keys_d, bad_d = decode(
+            toks_d, pages, lengths_d, keys_d, bad_d, *ex = decode(
                 self._params, self._pages_flat(), jnp.asarray(tables_c),
                 jnp.asarray(lengths_c), last_in,
                 jnp.asarray(temps_c), keys_in)
             self._set_pages(pages)
+            self._note_moe_stats(ex)
             chain = (slots, slot_reqs, nb, k, fresh, toks_d, lengths_d,
                      keys_d, bad_d)
             # queue heads whose slots this chain will free prefill NOW,
@@ -2748,9 +2926,10 @@ class Engine:
         keys_in = jnp.asarray(keys_c)
         chains = []
         for _ in range(budget):
-            toks_d, pages, lengths_in, keys_in, bad_d = decode(
+            toks_d, pages, lengths_in, keys_in, bad_d, *ex = decode(
                 self._params, pages, tables_j, lengths_in, last_in,
                 temps_j, keys_in)
+            self._note_moe_stats(ex)
             # the chain-to-chain handoff stays ON DEVICE: the next
             # chain's last-token input is the previous chain's final
             # column (statically gated by the analyze registry's
@@ -3245,6 +3424,90 @@ def bench_spec_decode(cfg, on_tpu):
                 stats["spec_ms_per_token"], 3)
             out["spec_k"] = stats["k"]
     return out
+
+
+def bench_moe_serving(cfg, on_tpu):
+    """MoE serving scenario (ISSUE 17): steady-state decode throughput
+    of the tiny MoE llama (8 experts, top-2, 64-wide expert FFs —
+    replicated routing, capacity-factor token budget, grouped-expert
+    Pallas FFN) against its equal-active-params dense twin (the 128-wide
+    tiny MLP: 2 experts * 64 active per token) on the SAME paged
+    geometry and workload.
+
+    Gate: dense/MoE decode-rate ratio <= 1.5 — router + sort + grouped
+    dispatch must cost less than half again the dense twin's step. The
+    comparison is interleaved (moe, dense) rep medians floored at the
+    50 ms single-core jitter floor; the CPU smoke host additionally runs
+    the grouped kernel in Pallas interpret mode, which the floor keeps
+    from reading as model cost. The metrics tail reports the router's
+    cumulative behavior: drop fraction (dropped pairs / routed pairs),
+    per-expert load imbalance (max/mean), mean router entropy in nats.
+    """
+    from .. import seed as _seed
+    from ..models.llama import (LlamaForCausalLM, tiny_llama_config,
+                                tiny_moe_llama_config)
+
+    del cfg  # the block sizes its own twin configs (CPU smoke parity)
+
+    slots = 4 if on_tpu else 2
+    new_tokens = 64 if on_tpu else 8
+    moe_cfg = tiny_moe_llama_config()
+
+    def build(mcfg):
+        _seed(0)
+        model = LlamaForCausalLM(mcfg)
+        model.eval()
+        return Engine(model, max_slots=slots, num_pages=64, page_size=8,
+                      chunk_size=4, max_chain=8 if on_tpu else 2,
+                      dtype=jnp.float32)
+
+    engines = {"moe": build(moe_cfg), "dense": build(tiny_llama_config())}
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, moe_cfg.vocab_size,
+                            (int(rng.integers(8, 24)),))
+               for _ in range(slots)]
+
+    def decode_once(eng):
+        reqs = [eng.add_request(p, new_tokens) for p in prompts]
+        eng._admit()       # prefill outside the timed window (r3 protocol)
+        done0 = sum(len(r.tokens) for r in reqs)
+        t0 = time.perf_counter()
+        while eng.step():
+            pass
+        dt = time.perf_counter() - t0
+        return sum(len(r.tokens) for r in reqs) - done0, dt
+
+    for eng in engines.values():   # two passes warm every compiled bucket
+        decode_once(eng)
+        decode_once(eng)
+    reps = 3
+    toks = {k: 0 for k in engines}
+    times = {k: [] for k in engines}
+    for _ in range(reps):
+        for key, eng in engines.items():      # interleaved rep pairs
+            n, dt = decode_once(eng)
+            toks[key] += n
+            times[key].append(dt)
+
+    floor_s = 0.020 if on_tpu else 0.050
+    med = {k: max(float(np.median(v)), floor_s) for k, v in times.items()}
+    thr = {k: toks[k] / (med[k] * reps) for k in engines}
+    ratio = thr["dense"] / thr["moe"] if thr["moe"] else float("inf")
+    stats = engines["moe"].moe_stats()
+    ok = ratio <= 1.5 and stats.get("tokens_routed", 0) > 0
+    if not ok:
+        print(f"WARNING: bench_moe gate failed: dense/moe decode ratio="
+              f"{ratio:.3f} (<=1.5), tokens_routed="
+              f"{stats.get('tokens_routed', 0)} (>0)")
+    return {
+        "moe_decode_tokens_per_sec": round(thr["moe"], 1),
+        "moe_dense_twin_tokens_per_sec": round(thr["dense"], 1),
+        "moe_dense_over_moe_ratio": round(ratio, 3),
+        "moe_drop_frac": round(float(stats["drop_frac"]), 4),
+        "moe_load_imbalance": round(float(stats["load_imbalance"]), 3),
+        "moe_router_entropy_nats": round(float(stats["router_entropy"]), 3),
+        "moe_gate_ok": bool(ok),
+    }
 
 
 def bench_prefix_cache(cfg, on_tpu):

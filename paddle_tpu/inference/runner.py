@@ -62,17 +62,24 @@ __all__ = ["ModelRunner"]
 # any model family exposing the llama-style separate projections shards)
 _COL_LAYERS = ("q_proj", "k_proj", "v_proj", "gate_proj", "up_proj")
 _ROW_LAYERS = ("o_proj", "down_proj")
+# stacked expert weights ([E, ...] leading expert dim) shard over 'ep';
+# the router stays replicated so routing is identical on every shard
+_EXPERT_LEAVES = ("experts_gate", "experts_up", "experts_down")
 
 
 class ModelRunner:
-    """Builds and caches the engine's compiled programs; owns the TP
-    mesh and sharding specs when ``tp > 1`` (see module docstring)."""
+    """Builds and caches the engine's compiled programs; owns the TP/EP
+    mesh and sharding specs when ``tp > 1`` or ``ep > 1`` (see module
+    docstring)."""
 
     AXIS = "tp"
+    EP_AXIS = "ep"
 
-    def __init__(self, engine, tp: Optional[int] = None):
+    def __init__(self, engine, tp: Optional[int] = None,
+                 ep: Optional[int] = None):
         self.engine = engine
         self.tp = int(tp) if tp else 1
+        self.ep = int(ep) if ep else 1
         self.mesh = None
         self.param_specs: Optional[List] = None
         # compiled-program caches (moved here from the monolithic Engine;
@@ -80,7 +87,7 @@ class ModelRunner:
         self.decode_fns: Dict[Tuple, object] = {}
         self.prefill_fns: Dict[Tuple, object] = {}
         self.mixed_fns: Dict[Tuple, object] = {}
-        if self.tp > 1:
+        if self.tp > 1 or self.ep > 1:
             self._validate_and_build_mesh()
 
     # ------------------------------------------------------------- mesh
@@ -88,29 +95,51 @@ class ModelRunner:
         from jax.sharding import Mesh
 
         cfg = self.engine.cfg
-        tp = self.tp
-        if self.engine.quantized:
+        tp, ep = self.tp, self.ep
+        if tp > 1 and self.engine.quantized:
             raise NotImplementedError(
                 "tp > 1 with quantized_cache: the int8 scale pages pack "
                 "k/v scales against the GLOBAL kv-head count in their "
                 "128-lane layout, which a lane-sharded pool would split "
                 "mid-field — serve bf16/f32 pages or tp=1")
         devices = jax.devices()
-        if len(devices) < tp:
+        if len(devices) < tp * ep:
             raise ValueError(
-                f"tp={tp} needs {tp} local devices, found {len(devices)} "
-                "(tests/tools force 8 virtual CPU devices via "
-                "--xla_force_host_platform_device_count)")
-        n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
-        if cfg.num_heads % tp or n_kv % tp:
-            raise ValueError(
-                f"tp={tp} must divide num_heads={cfg.num_heads} and "
-                f"num_kv_heads={n_kv} (the KV pool shards by head)")
-        inter = getattr(cfg, "intermediate_size", 0)
-        if inter and inter % tp:
-            raise ValueError(
-                f"tp={tp} must divide intermediate_size={inter}")
-        self.mesh = Mesh(np.asarray(devices[:tp]), (self.AXIS,))
+                f"tp={tp} x ep={ep} needs {tp * ep} local devices, found "
+                f"{len(devices)} (tests/tools force 8 virtual CPU "
+                "devices via --xla_force_host_platform_device_count)")
+        if tp > 1:
+            n_kv = getattr(cfg, "num_kv_heads", cfg.num_heads)
+            if cfg.num_heads % tp or n_kv % tp:
+                raise ValueError(
+                    f"tp={tp} must divide num_heads={cfg.num_heads} and "
+                    f"num_kv_heads={n_kv} (the KV pool shards by head)")
+            inter = getattr(cfg, "intermediate_size", 0)
+            if inter and inter % tp:
+                raise ValueError(
+                    f"tp={tp} must divide intermediate_size={inter}")
+        if ep > 1:
+            n_exp = getattr(cfg, "num_experts", 0)
+            if not n_exp:
+                raise ValueError(
+                    f"ep={ep} on a dense model: expert parallelism "
+                    "shards the stacked expert weights, which this "
+                    "config does not have (num_experts=0) — serve an "
+                    "MoE config or ep=1")
+            if n_exp % ep:
+                raise ValueError(
+                    f"ep={ep} must divide num_experts={n_exp} (each "
+                    "shard owns a contiguous block of experts)")
+        if tp > 1 and ep > 1:
+            # ep innermost: an expert all-to-all crosses the devices
+            # that already exchange the Megatron psums' partners' data
+            self.mesh = Mesh(
+                np.asarray(devices[:tp * ep]).reshape(tp, ep),
+                (self.AXIS, self.EP_AXIS))
+        elif ep > 1:
+            self.mesh = Mesh(np.asarray(devices[:ep]), (self.EP_AXIS,))
+        else:
+            self.mesh = Mesh(np.asarray(devices[:tp]), (self.AXIS,))
         self.param_specs = self._infer_param_specs()
 
     def _infer_param_specs(self) -> List:
@@ -133,6 +162,16 @@ class ModelRunner:
         parts = name.split(".")
         layer = parts[-2] if len(parts) >= 2 else ""
         leaf = parts[-1]
+        if leaf in _EXPERT_LEAVES:
+            if self.ep == 1:
+                return P()
+            if shape[0] % self.ep:
+                raise ValueError(
+                    f"{name}: expert dim {shape[0]} not divisible by "
+                    f"ep={self.ep}")
+            return P(self.EP_AXIS, None, None)
+        if self.tp == 1:
+            return P()  # ep-only mesh: dense weights replicate
         if "qkv_proj" in name:
             raise NotImplementedError(
                 "tp > 1 over a packed-QKV projection (GPT's [H, 3H] "
@@ -169,7 +208,9 @@ class ModelRunner:
     def page_spec(self):
         from jax.sharding import PartitionSpec as P
 
-        return P(None, None, self.AXIS)
+        # pages shard by KV-head lane over tp only; an ep-only mesh
+        # keeps the pool replicated (every shard runs full attention)
+        return P(None, None, self.AXIS) if self.tp > 1 else P()
 
     # -------------------------------------------------------- placement
     def place_params(self, arrays: List) -> List:
@@ -277,12 +318,20 @@ class ModelRunner:
             setattr(obj, attr, new)
 
         for lyr in self.engine.model.sublayers(include_self=True):
-            if hasattr(lyr, "o_proj") and hasattr(lyr, "num_heads"):
+            if hasattr(lyr, "router") and hasattr(lyr, "experts_gate"):
+                # MoE: the all_to_all/all_gather pair is STRUCTURAL (a
+                # shard only holds its expert block), so it stays armed
+                # even under strip_collectives
+                patch(lyr, "_ep_axis",
+                      self.EP_AXIS if self.ep > 1 else None)
+            elif tp > 1 and hasattr(lyr, "o_proj") \
+                    and hasattr(lyr, "num_heads"):
                 patch(lyr, "num_heads", lyr.num_heads // tp)
                 if hasattr(lyr, "num_kv_heads"):
                     patch(lyr, "num_kv_heads", lyr.num_kv_heads // tp)
                 patch(lyr, "_tp_axis", axis)
-            elif hasattr(lyr, "down_proj") and hasattr(lyr, "gate_proj"):
+            elif tp > 1 and hasattr(lyr, "down_proj") \
+                    and hasattr(lyr, "gate_proj"):
                 patch(lyr, "_tp_axis", axis)
         try:
             yield
@@ -329,6 +378,13 @@ class ModelRunner:
     # Raw builders live beside the engine (make_mixed_step_fn, the
     # closures below); the runner is where they meet the mesh. Each
     # get_* caches per shape key exactly as the monolithic engine did.
+    # MoE engines grow ONE trailing replicated output per program (the
+    # router-stats vector; replicated routing computes it identically
+    # on every shard) — verify stays stats-free (tap unarmed there).
+    @property
+    def _moe_extra(self) -> Tuple[str, ...]:
+        return ("r",) if getattr(self.engine, "_moe_stats_n", 0) else ()
+
     def get_decode(self, nb: int, k: int, sampling: bool):
         key = (nb, k, sampling)
         fn = self.decode_fns.get(key)
@@ -338,7 +394,8 @@ class ModelRunner:
                 eng._m.compiled.labels(kind="decode").inc()
             raw = eng._make_decode_raw(k, sampling)
             fn = self.wrap(raw, n_rest=5,
-                           out_desc=("r", "pages", "r", "r", "r"))
+                           out_desc=("r", "pages", "r", "r", "r")
+                           + self._moe_extra)
             self.decode_fns[key] = fn
         return fn
 
@@ -351,7 +408,8 @@ class ModelRunner:
                 eng._m.compiled.labels(kind="prefill").inc()
             raw = eng._make_prefill_raw(sampling, suffix)
             fn = self.wrap(raw, n_rest=6,
-                           out_desc=("r", "r", "r", "pages"))
+                           out_desc=("r", "r", "r", "pages")
+                           + self._moe_extra)
             self.prefill_fns[key] = fn
         return fn
 
@@ -366,7 +424,8 @@ class ModelRunner:
 
             raw = make_mixed_step_fn(eng, sampling)
             fn = self.wrap(raw, n_rest=7,
-                           out_desc=("r", "r", "r", "pages"))
+                           out_desc=("r", "r", "r", "pages")
+                           + self._moe_extra)
             self.mixed_fns[key] = fn
         return fn
 
@@ -385,15 +444,15 @@ class ModelRunner:
         eng = self.engine
         if kind == "decode":
             raw, n_rest = eng._make_decode_raw(k, sampling), 5
-            out = ("r", "pages", "r", "r", "r")
+            out = ("r", "pages", "r", "r", "r") + self._moe_extra
         elif kind == "mixed":
             from .engine import make_mixed_step_fn
 
             raw, n_rest = make_mixed_step_fn(eng, sampling), 7
-            out = ("r", "r", "r", "pages")
+            out = ("r", "r", "r", "pages") + self._moe_extra
         elif kind in ("prefill", "suffix"):
             raw = eng._make_prefill_raw(sampling, kind == "suffix")
-            n_rest, out = 6, ("r", "r", "r", "pages")
+            n_rest, out = 6, ("r", "r", "r", "pages") + self._moe_extra
         else:
             raise ValueError(f"unknown program kind {kind!r}")
         if not self.sharded:

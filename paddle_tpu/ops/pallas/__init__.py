@@ -19,6 +19,11 @@ from .paged_attention import (
     paged_decode_attention_ref,
     quantize_rows_int8,
 )
+from .grouped_matmul import (
+    grouped_matmul,
+    grouped_matmul_pallas,
+    grouped_matmul_ref,
+)
 from .quant_matmul import (
     quant_matmul,
     quant_matmul_pallas,

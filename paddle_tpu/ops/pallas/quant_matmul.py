@@ -106,11 +106,13 @@ def select_block_shapes(rows, k, n, weight_dtype):
                     bn = cand
                     break
         # bytes one K row of the weight block costs in VMEM (packed
-        # nibbles store two K rows per byte row)
-        per_row = bn if weight_dtype == "int8" else bn // 2
+        # nibbles store two K rows per byte row; the grouped MoE kernel
+        # reuses this budget logic for its float expert weight stacks)
+        per_row = {"int8": bn, "int4": bn // 2, "bfloat16": 2 * bn,
+                   "float32": 4 * bn}[weight_dtype]
         # whole-K needs the activation block's minor dim (bk for int8,
         # bk//2 for the int4 even/odd halves) to stay a 128-lane multiple
-        lane_mult = 128 if weight_dtype == "int8" else 256
+        lane_mult = 256 if weight_dtype == "int4" else 128
         if k % lane_mult == 0 and k * per_row <= _WEIGHT_BLOCK_BYTES:
             bk = k
         else:

@@ -1,0 +1,61 @@
+"""TPL1301 fixture — per-expert matmul dispatch loops in a serving
+module. The file name carries "inference" so the path-scoped moe
+family engages. A ``for`` over an expert axis issuing one
+matmul/dot/einsum per expert unrolls into E separate XLA dots; the
+grouped-expert kernel (``paddle_tpu.ops.pallas.grouped_matmul``)
+replaces the whole loop with one fused launch.
+"""
+import jax.numpy as jnp
+
+
+# -- violations: one kernel dispatch per expert ---------------------------
+
+
+def moe_ffn_unrolled(self, x):
+    outs = []
+    for e in range(self.num_experts):  # EXPECT: TPL1301
+        outs.append(jnp.matmul(x, self.experts_up[e]))
+    return jnp.stack(outs)
+
+
+def moe_ffn_einsum_unrolled(x, w_experts, num_experts):
+    acc = jnp.zeros_like(x)
+    for e in range(num_experts):  # EXPECT: TPL1301
+        acc = acc + jnp.einsum("th,hf->tf", x, w_experts[e])
+    return acc
+
+
+# -- suppressed: a justified one-off --------------------------------------
+
+
+def moe_reference_twin(x, w_experts, n_experts):
+    outs = []
+    for e in range(n_experts):  # tpulint: disable=TPL1301 -- fixture: test-only reference oracle, deliberately naive for bitwise comparison against the grouped kernel (EXPECT-SUPPRESSED: TPL1301)
+        outs.append(jnp.dot(x, w_experts[e]))
+    return jnp.stack(outs)
+
+
+# -- clean: the grouped kernel, and loops that are not expert dispatch ----
+
+
+def moe_ffn_grouped(x_sorted, w_experts, group_sizes):
+    from paddle_tpu.ops.pallas import grouped_matmul
+
+    # all experts stream through ONE fused kernel — the sanctioned path
+    return grouped_matmul(x_sorted, w_experts, group_sizes)
+
+
+def combine_topk(x, w, k):
+    # loop over top-k CHOICES, not experts: no expert axis in the bound
+    acc = jnp.zeros_like(x)
+    for j in range(k):
+        acc = acc + jnp.matmul(x, w[j])
+    return acc
+
+
+def expert_load_report(counts, num_experts):
+    # loop over experts WITHOUT a matmul dispatch: bookkeeping is fine
+    rows = []
+    for e in range(num_experts):
+        rows.append(f"expert {e}: {counts[e]}")
+    return "\n".join(rows)

@@ -1,0 +1,334 @@
+"""Expert-parallel MoE serving identity suite (ISSUE 17).
+
+The contract of the EP extension: sharding the expert weights over an
+ep-way mesh axis changes WHERE the expert FFN runs, never WHAT tokens
+come out. Routing is replicated (every shard routes all T tokens, so
+the capacity drop set and the renormalized combine weights are bitwise
+those of the ep=1 engine by construction); only the expert FFN is
+distributed — dispatch all_to_all, grouped Pallas matmul over the local
+experts, all_gather combine. Every identity test serves the same
+workload through a single-chip engine and through ep∈{1,2,4} (and
+tp=2 x ep=2) sharded engines over the virtual CPU mesh (conftest forces
+8 devices) and asserts the token streams are identical — greedy,
+sampled, spec ngram, chunked prefill, and under recompute preemption.
+Wired into ``make chaos``.
+
+The identity class is marked ``slow``: each scenario compiles several
+engines' MoE programs (interpret-mode grouped kernel on CPU), which
+does not fit tier-1's wall-clock budget beside the existing suites.
+``make chaos`` runs this file WITHOUT the marker filter. The cheap
+grouped-kernel parity, capacity-drop, and sharding-mechanics tests
+below stay in tier-1.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.models.llama import (
+    LlamaForCausalLM,
+    LlamaMoEMLP,
+    moe_stats_size,
+    moe_stats_tap,
+    tiny_llama_config,
+    tiny_moe_llama_config,
+)
+from paddle_tpu.ops.pallas import grouped_matmul, grouped_matmul_ref
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(tiny_moe_llama_config())
+    m.eval()
+    return m
+
+
+def make_engine(model, ep=None, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("num_pages", 64)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("max_chain", 2)
+    kw.setdefault("dtype", jnp.float32)
+    return Engine(model, ep=ep, **kw)
+
+
+def serve(model, ep=None, n_req=4, budget=8, temps=(0.0,), seed=3, **kw):
+    eng = make_engine(model, ep=ep, **kw)
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(n_req):
+        p = rng.integers(0, model.config.vocab_size,
+                         (int(rng.integers(6, 20)),))
+        reqs.append(eng.add_request(p, budget,
+                                    temperature=temps[i % len(temps)]))
+    eng.run()
+    return [list(r.tokens) for r in reqs], eng
+
+
+@pytest.mark.slow
+class TestMoETokenIdentity:
+    def test_greedy_and_sampled_across_ep(self, model):
+        """Greedy AND sampled streams bit-identical at ep=1/2/4 vs the
+        single-chip engine — the replicated-routing contract (sampled
+        keys are per-request and replicated across shards)."""
+        base, beng = serve(model, ep=None, temps=(0.0, 0.7))
+        bstats = beng.moe_stats()
+        assert bstats["tokens_routed"] > 0
+        for ep in (1, 2, 4):
+            got, eng = serve(model, ep=ep, temps=(0.0, 0.7))
+            assert got == base, f"ep={ep} diverged"
+            assert eng.runner.sharded == (ep > 1)
+            # the router's telemetry is replicated too: same drop set,
+            # same per-expert loads, at every ep
+            s = eng.moe_stats()
+            assert s["pairs_dropped"] == bstats["pairs_dropped"]
+            assert s["expert_load"] == bstats["expert_load"]
+
+    def test_tp_by_ep_composition(self, model):
+        """EP composes with TP on one mesh (devices reshape to tp x ep):
+        the composed engine reproduces the single-chip stream."""
+        base, _ = serve(model, ep=None, temps=(0.0, 0.7))
+        got, eng = serve(model, ep=2, tp=2, temps=(0.0, 0.7))
+        assert got == base
+        assert eng.runner.tp == 2 and eng.runner.ep == 2
+        assert eng.runner.mesh.devices.shape == (2, 2)
+
+    def test_chunked_prefill(self, model):
+        """Chunked prefill streams prompts through the mixed program's
+        MoE path — sharded expert weights included — and reproduces the
+        unchunked single-chip stream."""
+        base, _ = serve(model, ep=None)
+        for kw in (dict(ep=None, prefill_chunk=4),
+                   dict(ep=2, prefill_chunk=4)):
+            got, _ = serve(model, **kw)
+            assert got == base, f"{kw} diverged"
+
+    def test_spec_ngram(self, model):
+        """Greedy spec-ngram equals vanilla decode through the MoE
+        model, and the ep-sharded verify program preserves it."""
+        base, _ = serve(model, ep=None)
+        got1, _ = serve(model, ep=None, spec="ngram", spec_k=4)
+        got2, _ = serve(model, ep=2, spec="ngram", spec_k=4)
+        assert got1 == base
+        assert got2 == base
+
+    def test_preemption_under_pool_pressure(self, model):
+        """Recompute preemption (pool pressure evicts a running request,
+        re-admission re-prefills prompt+prefix) must reproduce the
+        pressure-free stream at every ep — the re-prefill runs back
+        through the MoE dispatch path."""
+
+        def tight_serve(ep):
+            # seed-3 prompts are 17/7/8 tokens; with 24-token budgets the
+            # two active slots' final lengths need 6+4 pages against a
+            # 9-page pool — decode growth must preempt
+            eng = make_engine(model, ep=ep, num_pages=9, max_slots=2)
+            rng = np.random.default_rng(3)
+            reqs = [eng.add_request(
+                rng.integers(0, model.config.vocab_size,
+                             (int(rng.integers(6, 20)),)), 24)
+                for _ in range(3)]
+            eng.run()
+            return [list(r.tokens) for r in reqs], reqs
+
+        base, _ = serve(model, ep=None, n_req=3, budget=24)
+        tight, treqs = tight_serve(None)
+        assert tight == base
+        assert any(r.retries > 0 for r in treqs), \
+            "pool was not tight enough to preempt — retune num_pages"
+        got, _ = tight_serve(2)
+        assert got == tight
+
+    def test_capacity_overload_degrades_never_crashes(self, model):
+        """An undersized capacity factor (heavy dropping) must still
+        serve to completion with identical streams at every ep — drops
+        renormalize, shapes stay static, nothing recompiles per step."""
+        try:
+            base, beng = serve(model, ep=None, capacity_factor=0.5)
+            assert beng.moe_stats()["drop_frac"] > 0.2
+            got, _ = serve(model, ep=4, capacity_factor=0.5)
+            assert got == base
+        finally:
+            # the override is a host-side setattr on the SHARED module
+            # model — restore the config default for later tests
+            for blk in model.model.layers:
+                blk.mlp.capacity_factor = float(
+                    model.config.capacity_factor)
+
+
+class TestGroupedKernelParity:
+    """The interpret-mode kernel vs the jax.lax.ragged_dot twin — an
+    oracle independent of every Pallas code path. At these single
+    k-block shapes the two are BITWISE equal (one f32 accumulation
+    chain per output element either way)."""
+
+    E, K, N = 4, 16, 32
+
+    def _rand(self, m, seed=0):
+        r = np.random.default_rng(seed)
+        lhs = jnp.asarray(r.standard_normal((m, self.K)), jnp.float32)
+        rhs = jnp.asarray(
+            r.standard_normal((self.E, self.K, self.N)), jnp.float32)
+        return lhs, rhs
+
+    def _check(self, lhs, rhs, sizes, valid=None):
+        got = grouped_matmul(lhs, rhs, sizes, valid)
+        want = grouped_matmul_ref(lhs, rhs, sizes, valid)
+        assert got.shape == want.shape
+        assert jnp.array_equal(got, want), "kernel != ragged_dot twin"
+        return got
+
+    def test_random_groups_bitwise(self):
+        lhs, rhs = self._rand(40, seed=1)
+        self._check(lhs, rhs, jnp.asarray([7, 13, 3, 17]))
+
+    def test_empty_expert_groups(self):
+        lhs, rhs = self._rand(24, seed=2)
+        out = self._check(lhs, rhs, jnp.asarray([0, 24, 0, 0]))
+        assert bool(jnp.any(out != 0))
+
+    def test_all_tokens_one_expert_each_position(self):
+        lhs, rhs = self._rand(16, seed=3)
+        for e in range(self.E):
+            sizes = [0] * self.E
+            sizes[e] = 16
+            self._check(lhs, rhs, jnp.asarray(sizes))
+
+    def test_valid_sizes_zero_capacity_padding(self):
+        """The serving layout: every group padded to capacity C, kept
+        counts in valid_sizes — rows past an expert's kept count come
+        back EXACTLY zero on both paths."""
+        cap = 8
+        lhs, rhs = self._rand(self.E * cap, seed=4)
+        sizes = jnp.full((self.E,), cap, jnp.int32)
+        valid = jnp.asarray([3, 8, 0, 5])
+        out = self._check(lhs, rhs, sizes, valid)
+        out = np.asarray(out)
+        for e, v in enumerate([3, 8, 0, 5]):
+            assert not np.any(out[e * cap + v:(e + 1) * cap])
+            if v:
+                assert np.any(out[e * cap:e * cap + v])
+
+    def test_rows_past_total_are_zero(self):
+        lhs, rhs = self._rand(30, seed=5)
+        out = self._check(lhs, rhs, jnp.asarray([5, 5, 5, 5]))
+        assert not np.any(np.asarray(out)[20:])
+
+
+class TestCapacityDrops:
+    """Capacity-factor token dropping at the layer level: deterministic,
+    renormalized, and visible through the stats tap."""
+
+    def _layer(self, cf):
+        paddle.seed(7)
+        lyr = LlamaMoEMLP(tiny_moe_llama_config(capacity_factor=cf))
+        lyr.eval()
+        return lyr
+
+    def test_drops_are_deterministic_and_renormalized(self):
+        lyr = self._layer(0.5)
+        x = jnp.asarray(
+            np.random.default_rng(11).standard_normal((2, 16, 64)),
+            jnp.float32)
+        with moe_stats_tap() as tap:
+            y1 = lyr.forward(x)
+        y2 = lyr.forward(x)
+        assert jnp.array_equal(y1._data if hasattr(y1, "_data") else y1,
+                               y2._data if hasattr(y2, "_data") else y2)
+        (stats,) = tap
+        stats = np.asarray(stats)
+        e = lyr.num_experts
+        t = 2 * 16
+        assert stats.shape == (e + 3,)
+        assert stats[e] > 0                       # pairs actually dropped
+        assert stats[e + 2] == t                  # routed-token count
+        # kept + dropped accounts for every (token, choice) pair
+        assert stats[:e].sum() + stats[e] == lyr.top_k * t
+        # per-expert kept counts respect the static capacity
+        cap = int(np.ceil(0.5 * lyr.top_k * t / e))
+        assert (stats[:e] <= cap).all()
+
+    def test_generous_capacity_drops_nothing(self):
+        lyr = self._layer(8.0)  # capacity >= worst-case routing
+        x = jnp.asarray(
+            np.random.default_rng(12).standard_normal((1, 8, 64)),
+            jnp.float32)
+        with moe_stats_tap() as tap:
+            lyr.forward(x)
+        stats = np.asarray(tap[0])
+        assert stats[lyr.num_experts] == 0
+
+    def test_stats_tap_off_by_default(self):
+        lyr = self._layer(1.25)
+        x = jnp.zeros((1, 4, 64), jnp.float32)
+        lyr.forward(x)  # no tap armed: must not blow up, nothing records
+
+    def test_stats_size(self):
+        assert moe_stats_size(tiny_moe_llama_config()) == 8 + 3
+        assert moe_stats_size(tiny_llama_config()) == 0
+
+
+class TestMoEEngineMechanics:
+    def test_expert_weights_sharded_router_replicated(self, model):
+        from jax.sharding import PartitionSpec as P
+
+        eng = make_engine(model, ep=2)
+        specs = eng.runner.param_specs
+        assert P("ep", None, None) in specs     # experts_gate/up/down
+        # dense weights (router included) replicate on the ep-only mesh
+        assert P() in specs
+        assert eng.runner.mesh.axis_names == ("ep",)
+        # the paged pool stays unsharded at tp=1
+        assert eng.k_pages[0].sharding.is_fully_replicated
+
+    def test_validation_errors(self, model):
+        # ep must divide num_experts (8)
+        with pytest.raises(ValueError, match="num_experts"):
+            make_engine(model, ep=3)
+        # ep on a dense model is a config error, not a silent no-op
+        paddle.seed(0)
+        dense = LlamaForCausalLM(tiny_llama_config())
+        dense.eval()
+        with pytest.raises(ValueError, match="num_experts"):
+            Engine(dense, max_slots=2, num_pages=16, page_size=8,
+                   chunk_size=4, dtype=jnp.float32, ep=2)
+        # capacity_factor on a dense model, and non-positive values
+        with pytest.raises(ValueError, match="capacity_factor"):
+            Engine(dense, max_slots=2, num_pages=16, page_size=8,
+                   chunk_size=4, dtype=jnp.float32, capacity_factor=1.0)
+        with pytest.raises(ValueError, match="capacity_factor"):
+            make_engine(model, capacity_factor=0.0)
+
+    def test_capacity_factor_override_reaches_layers(self, model):
+        try:
+            eng = make_engine(model, capacity_factor=2.0)
+            del eng
+            for blk in model.model.layers:
+                assert blk.mlp.capacity_factor == 2.0
+        finally:
+            # restore the config default for the other tests sharing
+            # the module-scoped model
+            for blk in model.model.layers:
+                blk.mlp.capacity_factor = float(
+                    model.config.capacity_factor)
+
+    def test_dense_engine_moe_surface_empty(self):
+        paddle.seed(0)
+        dense = LlamaForCausalLM(tiny_llama_config())
+        dense.eval()
+        eng = Engine(dense, max_slots=2, num_pages=16, page_size=8,
+                     chunk_size=4, dtype=jnp.float32)
+        assert eng.moe_stats() == {}
+        assert eng._moe_stats_n == 0
+
+    def test_single_chip_moe_unchanged(self, model):
+        """ep=None MoE engines carry no mesh — the dense-engine serving
+        machinery plus the in-model grouped FFN, nothing sharded."""
+        eng = make_engine(model)
+        assert not eng.runner.sharded
+        assert eng.runner.mesh is None
+        assert eng._moe_stats_n == moe_stats_size(model.config)

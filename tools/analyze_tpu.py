@@ -709,6 +709,46 @@ def _multi_step_decode():
     return multi_step_decode, args, kw
 
 
+def _moe_decode_step():
+    """Expert-parallel MoE decode chain (ISSUE 17): routing replicated
+    (every shard ranks ALL tokens, so the drop set and combine weights
+    are bit-identical to ep=1 by construction), stacked expert weights
+    P('ep', ...), and per MoE layer exactly one all_to_all (capacity-
+    slot token dispatch) + one all_gather (expert outputs) INSIDE the
+    same shard_map region as the decode scan — no TPC502 boundary
+    reshard, no TPC503 weight gather. At mesh 1 the python-level
+    ``ax is None`` branches emit no collectives at all."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference.engine import Engine
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         tiny_moe_llama_config)
+
+    paddle.seed(0)
+    ep = _mesh_n()
+    model = LlamaForCausalLM(tiny_moe_llama_config())
+    model.eval()
+    eng = Engine(model, max_slots=2, num_pages=32, page_size=8,
+                 chunk_size=4, dtype=jnp.float32, max_chain=2,
+                 ep=ep if ep > 1 else None)
+    nb = 2
+    fn = eng.runner.traceable("decode", sampling=False, k=1)
+    fn.__name__ = "moe_decode_step"
+    tables = np.zeros((nb, eng.max_pages_per_seq), np.int32)
+    tables[:, :2] = [[1, 2], [3, 4]]
+    args = [eng._params, eng._pages_flat(), jnp.asarray(tables),
+            jnp.asarray(np.array([9, 6], np.int32)),   # lengths
+            jnp.zeros((nb,), jnp.int32),               # last_tok
+            jnp.zeros((nb,), jnp.float32),             # temps
+            jnp.zeros((nb, 2), jnp.uint32)]            # keys
+    kw = {"donate_argnums": (1,), "check_processes": 2}
+    if eng.runner.mesh is not None:
+        kw["mesh"] = eng.runner.mesh
+    return fn, args, kw
+
+
 ENTRIES: List[Entry] = [
     Entry("llama_decode_step", _llama_decode_step,
           "serving decode: one token through the slab KV cache"),
@@ -754,6 +794,10 @@ ENTRIES: List[Entry] = [
     Entry("multi_step_decode", _multi_step_decode,
           "multi-step scheduling: two decode chains composed device-"
           "side, one harvest fence (ISSUE 12)", meshable=True),
+    Entry("moe_decode_step", _moe_decode_step,
+          "EP MoE decode chain: replicated routing, expert-sharded "
+          "weights, a2a dispatch + all_gather combine (ISSUE 17)",
+          meshable=True),
 ]
 
 

@@ -51,7 +51,7 @@ import analyze_tpu as registry  # noqa: E402  (forces virtual devices)
 PLAN_ENTRIES = [e.name for e in registry.ENTRIES if e.meshable]
 # the committed golden fixtures (satellite: ≥3 entries, byte-stable)
 GOLDEN_ENTRIES = ("tp_train_step", "tp_sharded_decode_step",
-                  "moe_ep_gspmd")
+                  "moe_ep_gspmd", "moe_decode_step")
 GOLDEN_MESH = 8
 GOLDEN_DEVICE = "v5e"
 

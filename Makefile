@@ -41,7 +41,8 @@ chaos:
 		tests/test_chunked_prefill.py tests/test_tp_serving.py \
 		tests/test_moe_serving.py tests/test_multi_step.py \
 		tests/test_api_server.py tests/test_replica_failover.py \
-		tests/test_integrity.py tests/test_kv_tier.py -q
+		tests/test_integrity.py tests/test_kv_tier.py \
+		tests/test_tracing.py -q
 
 # chaos-serve — the multi-replica failover suite alone (ISSUE 13):
 # SIGKILL/poison a replica mid-stream, assert every client stream
@@ -75,7 +76,20 @@ serve-smoke:
 		examples/serve_llama_paged.py --tiny --api-port 0 --api-smoke \
 		--multi-step 2 --tenant-weights "interactive=4,batch=1"
 
-test: lint analyze plan chaos
+# trace-smoke — end-to-end tracing surface (ISSUE 18): serve the tiny
+# demo with --trace on, dump the ring snapshot, convert it to Chrome
+# trace-event JSON through tools/trace_tpu.py, and validate the result
+# round-trips (non-empty, phase-correct events). Gates `test`.
+trace-smoke:
+	JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= python \
+		examples/serve_llama_paged.py --tiny --trace on \
+		--trace-dump /tmp/paddle_tpu_trace_snap.json
+	python tools/trace_tpu.py \
+		--from-file /tmp/paddle_tpu_trace_snap.json \
+		--out /tmp/paddle_tpu_trace_chrome.json
+	python tools/trace_tpu.py --check /tmp/paddle_tpu_trace_chrome.json
+
+test: lint analyze plan chaos trace-smoke
 	python -m pytest tests/ -x -q --ignore=tests/onchip
 
 onchip:
@@ -85,4 +99,4 @@ bench:
 	python bench.py
 
 .PHONY: lint analyze plan chaos chaos-serve chaos-integrity chaos-tier \
-	serve-smoke test onchip bench
+	serve-smoke trace-smoke test onchip bench

@@ -439,6 +439,20 @@ def bench_failover(cfg, on_tpu):
         return {"failover_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_trace(cfg, on_tpu):
+    """Request-tracing overhead scenario (ISSUE 18): the span recorder's
+    steady-state cost as an interleaved-rep ratio of median scheduling-
+    step times, tracing on vs off, on the bench_slo engine geometry.
+    Gate: <2% median step overhead over the 50 ms single-core jitter
+    floor, with >0 spans recorded."""
+    try:
+        from paddle_tpu.serving.loadgen import bench_trace_serving
+
+        return bench_trace_serving(cfg, on_tpu)
+    except Exception as e:
+        return {"trace_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_integrity(cfg, on_tpu):
     """Data-integrity scenario (ISSUE 14): the online-audit layer's
     steady-state cost — weight-shard audits, per-page KV checksums at
@@ -733,6 +747,7 @@ def main():
     slo = bench_slo(decode_cfg, on_tpu)
     failover = bench_failover(decode_cfg, on_tpu)
     integrity = bench_integrity(decode_cfg, on_tpu)
+    trace = bench_trace(decode_cfg, on_tpu)
     resume = bench_resume(on_tpu)
     multichip = bench_multichip()
     plan = bench_plan(multichip)
@@ -859,6 +874,11 @@ def main():
             metric_total("paddle_tpu_replica_quarantines_total")),
         "integrity_overhead_frac": integrity.get(
             "integrity_overhead_frac", 0.0),
+        # request-tracing surface (ISSUE 18): spans committed to the
+        # ring across the whole run and the overhead block's own gate
+        "trace_spans_total": int(
+            metric_total("paddle_tpu_trace_spans_total")),
+        "trace_overhead_frac": trace.get("trace_overhead_frac", 0.0),
         # training-resilience surface (ISSUE 7): checkpoint commits and
         # the in-loop guard counters as the registry saw them
         "train_checkpoints": int(
@@ -920,6 +940,7 @@ def main():
         **slo,
         **failover,
         **integrity,
+        **trace,
         **resume,
         **multichip,
         "metrics": metrics_block,

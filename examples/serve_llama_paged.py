@@ -154,6 +154,38 @@ def _api_smoke(server):
         return False
 
 
+def _trace_report(args):
+    """End-of-run tracing surface (--trace on/flight-only): per-run
+    TTFT decomposition stats line (queue/placement/prefill/promote
+    fractions from the component histogram) and the optional ring
+    snapshot dump for tools/trace_tpu.py."""
+    import json
+
+    from paddle_tpu.observability.tracing import (
+        TRACER, ttft_decomposition_summary)
+
+    if not TRACER.enabled:
+        return
+    d = ttft_decomposition_summary()
+    if d.get("n"):
+        mean_ms = 1e3 * d["ttft_sum_s"] / d["n"]
+        print("ttft decomposition: "
+              f"queue {100 * d.get('queue_wait_frac', 0.0):.1f}% | "
+              f"placement {100 * d.get('placement_frac', 0.0):.1f}% | "
+              f"prefill {100 * d.get('prefill_frac', 0.0):.1f}% | "
+              f"promote {100 * d.get('promote_wait_frac', 0.0):.1f}% "
+              f"(n={int(d['n'])}, mean ttft {mean_ms:.1f} ms)",
+              flush=True)
+    if args.trace_dump:
+        records = TRACER.snapshot()
+        with open(args.trace_dump, "w", encoding="utf-8") as f:
+            json.dump({"mode": args.trace, "process": "serve",
+                       "records": records}, f)
+        print(f"trace: {len(records)} records -> {args.trace_dump} "
+              "(export: python tools/trace_tpu.py --from-file "
+              f"{args.trace_dump} --out trace.json)", flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--tiny", action="store_true")
@@ -327,6 +359,20 @@ def main():
     ap.add_argument("--metrics-jsonl", default=None,
                     help="append one JSONL metrics snapshot here after "
                          "the run")
+    ap.add_argument("--trace", choices=["off", "on", "flight-only"],
+                    default="off",
+                    help="request tracing (ISSUE 18): 'on' records "
+                         "spans/events into the in-memory ring and "
+                         "serves live snapshots at /debug/trace (export "
+                         "with tools/trace_tpu.py); 'flight-only' "
+                         "records the ring for crash postmortems but "
+                         "refuses live scrapes. Off by default — the "
+                         "disabled path is a single attribute check")
+    ap.add_argument("--trace-dump", default=None, metavar="PATH",
+                    help="write the final trace-ring snapshot here as "
+                         "JSON (the /debug/trace body shape; feed to "
+                         "tools/trace_tpu.py --from-file). Needs "
+                         "--trace on/flight-only")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -345,6 +391,11 @@ def main():
         # preemption/retrace counters — see README "Observability"
         print(f"metrics: http://localhost:{server.port}/metrics",
               flush=True)
+
+    if args.trace != "off":
+        from paddle_tpu.observability.tracing import configure_tracing
+
+        configure_tracing(args.trace, process="serve")
 
     paddle.seed(0)
     moe = args.moe or (args.ep or 0) > 1 or args.capacity_factor is not None
@@ -408,6 +459,7 @@ def main():
 
     if args.api_port is not None:
         run_api_server(eng, args)
+        _trace_report(args)
         if server is not None:
             server.close()
         return
@@ -476,6 +528,7 @@ def main():
               f"accept rate {s['accept_rate']:.2f}, "
               f"{s['spec_ms_per_token']:.2f} ms/token")
 
+    _trace_report(args)
     if args.metrics_jsonl:
         from paddle_tpu.observability import write_jsonl_snapshot
 

@@ -97,6 +97,14 @@ _MUTABLE_LITERALS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
 
 _CORE_ALIASES = {"np", "jnp", "jax", "lax"}
 
+# The tracing API surface (paddle_tpu.observability.tracing, ISSUE 18):
+# importing any of these from an observability module marks the bound
+# name as a TPL1401 receiver — a tracing call under trace in
+# inference/ops outranks the generic TPL601 metrics diagnosis.
+_TRACING_NAMES = {"tracing", "span", "instant", "complete", "Tracer",
+                  "TRACER", "get_tracer", "configure_tracing",
+                  "flight_record", "new_trace_id", "SpanContext"}
+
 
 def _tail_name(node: ast.AST) -> Optional[str]:
     """Last dotted component of a Name/Attribute/Call-func expression."""
@@ -175,6 +183,10 @@ class _ModuleAnalyzer:
         self.obs_aliases: Set[str] = set()       # names bound to the
         # observability package (absolute OR relative import) — receivers
         # of TPL601's "metrics call under trace" check
+        self.trace_aliases: Set[str] = set()     # names bound to the
+        # tracing module specifically (span/instant/Tracer/...) —
+        # receivers of TPL1401's "tracing call under trace" check,
+        # which outranks TPL601 in inference/ops modules
         self.err_aliases: Set[str] = set()       # names imported from an
         # errors module (the serving error taxonomy) — referencing one in
         # a broad handler satisfies TPL701's wrapping requirement
@@ -217,6 +229,9 @@ class _ModuleAnalyzer:
                     if "observability" in a.name:
                         self.obs_aliases.add(
                             a.asname or a.name.split(".")[0])
+                        if "tracing" in a.name:
+                            self.trace_aliases.add(
+                                a.asname or a.name.split(".")[0])
             elif isinstance(n, ast.ImportFrom):
                 # observability bindings resolve the same way for
                 # absolute (paddle_tpu.observability) and relative
@@ -224,6 +239,12 @@ class _ModuleAnalyzer:
                 if n.module and "observability" in n.module:
                     self.obs_aliases.update(a.asname or a.name
                                             for a in n.names)
+                    # the tracing API's names, imported from the
+                    # tracing module itself or the package re-export
+                    self.trace_aliases.update(
+                        a.asname or a.name for a in n.names
+                        if "tracing" in n.module
+                        or a.name in _TRACING_NAMES)
                 elif n.module and "errors" in n.module.split("."):
                     self.err_aliases.update(a.asname or a.name
                                             for a in n.names)
@@ -520,15 +541,30 @@ class _ModuleAnalyzer:
                 if rnd is not None:
                     self._add(R.IMPURE_RANDOM, n,
                               f"{rnd} in traced function {fi.qualname!r}")
-                # TPL601 — metrics recorded under trace: any call whose
-                # receiver chain roots at an observability import
-                # (obs.counter(...), counter(...).inc(), reg.gauge(...))
+                # TPL601/TPL1401 — telemetry recorded under trace: any
+                # call whose receiver chain roots at an observability
+                # import (obs.counter(...), counter(...).inc(),
+                # reg.gauge(...)). A TRACING-API call (span/instant/
+                # Tracer/...) in an inference/ops module gets the more
+                # specific TPL1401 diagnosis instead.
                 root = _call_chain_root(n.func)
-                if root in self.obs_aliases:
+                if root in self.obs_aliases or root in self.trace_aliases:
                     shown = _dotted(n.func) or root
-                    self._add(R.OBSERVABILITY_IN_TRACE, n,
-                              f"{shown}(...) in traced function "
-                              f"{fi.qualname!r}")
+                    is_tracing = (root in self.trace_aliases
+                                  or any(p in _TRACING_NAMES
+                                         for p in shown.split(".")))
+                    parts = self.path.replace("\\", "/").split("/")
+                    eng_path = any("inference" in p or p == "ops"
+                                   for p in parts)
+                    if is_tracing and eng_path:
+                        self._add(R.TRACING_IN_TRACE, n,
+                                  f"{shown}(...) in traced function "
+                                  f"{fi.qualname!r} — tracing is host "
+                                  "telemetry; record between dispatches")
+                    else:
+                        self._add(R.OBSERVABILITY_IN_TRACE, n,
+                                  f"{shown}(...) in traced function "
+                                  f"{fi.qualname!r}")
                 # TPL302 — printing tracers
                 if (isinstance(n.func, ast.Name)
                         and n.func.id in ("print", "str", "repr")

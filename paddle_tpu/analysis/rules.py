@@ -293,4 +293,17 @@ PER_EXPERT_DISPATCH_LOOP = _rule(
     "f32 accumulation, capacity-padding aware via valid_sizes).")
 
 
+TRACING_IN_TRACE = _rule(
+    "TPL1401", "observability", "tracing-call-in-trace",
+    "paddle_tpu.observability.tracing API call (span/instant/complete/"
+    "Tracer/flight_record) inside traced code in paddle_tpu/{inference,"
+    "ops}/: the span opens ONCE at trace time (its duration measures "
+    "compilation, not execution, and it never closes per step), an "
+    "instant records a single event for the program's whole lifetime, "
+    "and any tensor-derived arg is a tracer the ring cannot hold. "
+    "Tracing is HOST telemetry (ISSUE 18) — record between dispatches "
+    "in the scheduler, or return the value out of the compiled region "
+    "and record at harvest. The metrics sibling is TPL601.")
+
+
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -192,6 +192,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor, pause_tape
+from ..observability.tracing import TRACER as _TRACER
+from ..observability.tracing import flight_record as _flight_record
 from ..ops.pallas.paged_attention import PagedCacheState
 from ..testing.faultinject import FaultPlan, InjectedFault, plan_from_flags
 from .errors import (
@@ -361,8 +363,15 @@ class Request:
     failure: Optional[BaseException] = None  # taxonomy error on FAILED
     failure_reason: Optional[str] = None     # its stable reason slug
     _key: Optional[np.ndarray] = None  # live PRNG key (survives preemption)
+    # request tracing (ISSUE 18): parent SpanContext wire string the
+    # engine's spans/instants nest under; None when tracing is off or
+    # the caller didn't propagate one
+    trace: Optional[str] = None
     # telemetry timestamps (host wall clock, perf_counter units):
     _t_arrival: float = 0.0          # add_request time (TTFT base)
+    _t_submit: Optional[float] = None  # upstream submit time (placement)
+    _t_admit: Optional[float] = None   # slot admission (prefill base)
+    _t_promote_wait: float = 0.0       # KV-tier promote wait inside admit
     _t_first: Optional[float] = None   # first generated-token harvest
     _t_last: Optional[float] = None    # latest harvest (TPOT base)
     _admitted: bool = False            # queue-wait recorded once
@@ -410,6 +419,17 @@ class _EngineMetrics:
             "paddle_serving_queue_wait_seconds",
             "request arrival to slot admission, by tenant",
             labelnames=("tenant",))
+        # TTFT latency attribution (ISSUE 18): the components partition
+        # [submit, first-token] exactly on one perf_counter clock —
+        # placement (upstream submit → engine arrival) + queue_wait
+        # (arrival → admission, minus promote) + promote_wait (KV-tier
+        # promotions awaited during admission splice) + prefill
+        # (admission → first harvest) sum to the observed TTFT.
+        self.ttft_component = histogram(
+            "paddle_serving_ttft_component_seconds",
+            "TTFT decomposition: placement|queue_wait|promote_wait|"
+            "prefill component of arrival-to-first-token",
+            labelnames=("component",))
         self.step_seconds = histogram(
             "paddle_serving_step_seconds",
             "wall time of one scheduling step (dispatch+harvest fence)")
@@ -577,6 +597,11 @@ class _EngineMetrics:
         self._tenant_seen: set = set()
         self._ttft_children: Dict[str, object] = {}
         self._qwait_children: Dict[str, object] = {}
+        # TTFT-component children: four fixed labels, cached eagerly
+        self._component_children: Dict[str, object] = {
+            c: self.ttft_component.labels(component=c)
+            for c in ("placement", "queue_wait", "promote_wait",
+                      "prefill")}
 
     _TENANT_CAP = 24  # distinct tenant label values before "other"
     _EXPERT_CAP = 32  # distinct expert label values before "other"
@@ -635,6 +660,7 @@ class _EngineMetrics:
         if req._t_first is None:
             req._t_first = now
             self.ttft_for(req.tenant).observe(now - req._t_arrival)
+            self._on_first_token(req, now)
             if fresh > 1:
                 # a chained harvest delivers first token + decode tokens
                 # at once; attribute the span evenly to the decode tokens
@@ -643,6 +669,39 @@ class _EngineMetrics:
             self.tpot.observe((now - req._t_last) / fresh)
         req._t_last = now
         self.tokens.inc(fresh)
+
+    def _on_first_token(self, req: Request, now: float):
+        """TTFT latency attribution (ISSUE 18), emitted once at first
+        harvest: the four components partition [submit, first-token] on
+        the perf_counter clock — placement = submit→arrival, queue_wait
+        = arrival→admit minus the promote wait spent inside the
+        admission splice, promote_wait = that wait, prefill =
+        admit→first-token — so their sum IS the TTFT (float error
+        only). Observed into the labeled histogram always; laid down as
+        retroactive child spans when the request carries a trace."""
+        base = req._t_submit if req._t_submit is not None \
+            else req._t_arrival
+        admit = req._t_admit if req._t_admit is not None \
+            else req._t_arrival
+        promote = req._t_promote_wait
+        comps = (
+            ("placement", base, req._t_arrival - base),
+            ("queue_wait", req._t_arrival,
+             (admit - req._t_arrival) - promote),
+            ("promote_wait", admit - promote, promote),
+            ("prefill", admit, now - admit),
+        )
+        for cname, _, dur in comps:
+            self._component_children[cname].observe(max(0.0, dur))
+        if _TRACER.enabled and req.trace is not None:
+            wall = time.time()
+            for cname, t0, dur in comps:
+                _TRACER.complete(f"ttft.{cname}", "ttft",
+                                 wall - (now - t0), dur,
+                                 parent=req.trace, rid=req.rid)
+            _TRACER.complete("ttft", "ttft", wall - (now - base),
+                             now - base, parent=req.trace,
+                             rid=req.rid, tenant=req.tenant)
 
 
 class Engine:
@@ -804,6 +863,10 @@ class Engine:
         self._has_deadlines = deadline_s is not None
         self._stall_steps = 0  # consecutive queued-but-unadmittable steps
         self._pending_inflight = []  # pre-admissions the current step owns
+        # promote wait measured by the most recent _splice_prefix — the
+        # admission loop attributes it to the request it spliced for
+        # (TTFT decomposition, ISSUE 18)
+        self._last_promote_wait_s = 0.0
         # deterministic fault injection: explicit plan/spec wins, else the
         # FLAGS_fault_inject / PADDLE_TPU_FAULT_INJECT flag
         self._fi = (FaultPlan.from_spec(fault_plan)
@@ -927,7 +990,8 @@ class Engine:
                     temperature=0.0, seed=None,
                     deadline_s: Optional[float] = None,
                     tenant: Optional[str] = None,
-                    resume_tokens=None) -> Request:
+                    resume_tokens=None, trace=None,
+                    t_submit: Optional[float] = None) -> Request:
         """Submit a request. EVERY way the request could be unservable is
         checked here, up front (ISSUE 6 satellite): malformed input →
         ``ValidationError``, a sequence the pool/table geometry can never
@@ -1052,6 +1116,18 @@ class Engine:
                     jnp.asarray(key0), jnp.int32(len(resumed)))),
                     np.uint32)
         req._t_arrival = time.perf_counter()
+        if _TRACER.enabled:
+            # ISSUE 18: carry the upstream span context (wire string)
+            # so engine spans/instants land in the caller's trace, and
+            # the upstream submit time so the TTFT decomposition's
+            # placement component spans submit -> engine arrival
+            req.trace = trace if isinstance(trace, str) and trace else None
+            if t_submit is not None:
+                req._t_submit = float(t_submit)
+            _TRACER.instant("engine.enqueue", "engine",
+                            parent=req.trace, rid=req.rid,
+                            prompt_len=int(prompt.size),
+                            queue_depth=len(self._queue))
         ttl = deadline_s if deadline_s is not None else self.deadline_s
         if ttl is not None:
             req.deadline = req._t_arrival + float(ttl)
@@ -1230,6 +1306,7 @@ class Engine:
         never corrupted by the harness), the cache invalidates it and
         every descendant block, and THIS admission recomputes from scratch
         — corruption costs a miss, never a wrong token."""
+        self._last_promote_wait_s = 0.0
         if self._pcache is None:
             return 0
         if self._cache.tier is not None:
@@ -1246,7 +1323,16 @@ class Engine:
                                                 tiers=True)
             if demoted:
                 tier.request_promote(demoted)
+                t0 = time.perf_counter()
                 tier.await_promotions(demoted)
+                # attributed to the admitting request's promote_wait
+                # TTFT component by _admit_dispatch/_bind_chunked
+                self._last_promote_wait_s = time.perf_counter() - t0
+                if _TRACER.enabled:
+                    _TRACER.instant(
+                        "kvtier.promote_wait", "cache",
+                        waited_s=self._last_promote_wait_s,
+                        pages=len(demoted))
             pages, matched, _ = self._pcache.lookup(prefix, tiers=True)
         else:
             pages, matched = self._pcache.lookup(prefix)
@@ -1287,6 +1373,10 @@ class Engine:
                 self._pcache.misses += 1
         if self._m is not None:
             (self._m.pc_hits if matched else self._m.pc_misses).inc()
+        if _TRACER.enabled:
+            _TRACER.instant("cache.prefix_lookup", "cache",
+                            matched=int(matched),
+                            prefix_len=int(prefix.size))
         if not matched:
             return 0
         cow = None
@@ -1729,6 +1819,12 @@ class Engine:
             slot = self._free_slots.pop()
             self._queue.pop(0)
             base = self._splice_prefix(self.tables[slot], prefix)
+            # attribute the splice's KV-tier promote wait to THIS
+            # request's TTFT decomposition (first admission only —
+            # re-admission after preemption is preemption cost, just
+            # like queue-wait in _note_admitted)
+            if not req._admitted:
+                req._t_promote_wait += self._last_promote_wait_s
             try:
                 got = self._ensure_pages(slot, prefix.size)
             except RequestError as e:
@@ -1765,10 +1861,18 @@ class Engine:
     def _note_admitted(self, req):
         """Queue-wait telemetry: first slot admission only (re-admission
         after preemption is preemption cost, already counted there)."""
-        if self._m is not None and not req._admitted:
-            req._admitted = True
+        if req._admitted:
+            return
+        req._admitted = True
+        req._t_admit = time.perf_counter()
+        if self._m is not None:
             self._m.queue_wait_for(req.tenant).observe(
-                time.perf_counter() - req._t_arrival)
+                req._t_admit - req._t_arrival)
+        if _TRACER.enabled:
+            _TRACER.instant("engine.admit", "engine",
+                            parent=req.trace, rid=req.rid,
+                            slot=req.slot,
+                            promote_wait_s=req._t_promote_wait)
 
     def _prefill_wave(self, rows):
         """Dispatch ONE bucketed prefill for ``rows`` of (req, prefix,
@@ -1794,6 +1898,10 @@ class Engine:
         counts. Deployments with very large max_slots would revisit."""
         if self._m is not None:
             self._m.prefill_batch.observe(len(rows))
+        if _TRACER.enabled:
+            _TRACER.instant(
+                "engine.prefill_wave", "engine", wave=len(rows),
+                rids=[req.rid for req, *_ in rows])
         self._flush_cow()
         suffix_mode = any(base for *_, base in rows)
         if suffix_mode and self._m is not None:
@@ -1866,6 +1974,12 @@ class Engine:
         for v in vals:
             agg += np.asarray(v, np.float64)
         self._moe_tot += agg
+        if _TRACER.enabled:
+            e = self._moe_stats_n - 3
+            _TRACER.instant("engine.moe_dispatch", "moe",
+                            dispatches=len(pend),
+                            kept=float(np.sum(agg[:e])),
+                            dropped=float(agg[e]))
         if self._m is not None:
             e = self._moe_stats_n - 3
             if agg[e]:
@@ -1994,6 +2108,13 @@ class Engine:
                 self._m.on_harvest(req, len(fresh))
             if req.done and not was_done:
                 self._m.completed.inc()
+        if _TRACER.enabled and fresh:
+            # the flight recorder's "victim's last decode steps": one
+            # instant per harvest, carrying the delivered tokens
+            _TRACER.instant("engine.harvest", "engine",
+                            parent=req.trace, rid=req.rid,
+                            fresh=len(fresh), total=len(req.tokens),
+                            done=req.done)
         if fresh and req.on_token is not None:
             try:
                 req.on_token(fresh)
@@ -2275,6 +2396,12 @@ class Engine:
             slot = self._free_slots.pop()
             self._queue.pop(0)
             base = self._splice_prefix(self.tables[slot], prefix)
+            # attribute the splice's KV-tier promote wait to THIS
+            # request's TTFT decomposition (first admission only —
+            # re-admission after preemption is preemption cost, just
+            # like queue-wait in _note_admitted)
+            if not req._admitted:
+                req._t_promote_wait += self._last_promote_wait_s
             try:
                 got = self._ensure_pages(
                     slot, min(prefix.size, base + chunk))
@@ -2379,6 +2506,10 @@ class Engine:
                 self._m.prefill_chunks.inc(n_chunks)
                 self._m.pc_computed_tokens.inc(chunk_toks)
             self._m.slab_dispatch.labels(path="chunked_prefill").inc()
+        if _TRACER.enabled and n_chunks:
+            _TRACER.instant("engine.prefill_chunk", "engine",
+                            chunks=n_chunks, tokens=chunk_toks,
+                            decode_rows=n - n_chunks)
         self._flush_cow()
         sampling = bool(np.any(temps_c > 0.0))
         mixed = self._get_mixed(nb, sampling)
@@ -2669,6 +2800,15 @@ class Engine:
                 self.num_pages - 1 - len(self._free_pages))
             if self._pcache is not None:
                 self._m.pc_pages.set(self._pcache.n_pages)
+        if _TRACER.enabled:
+            # retroactive step span: start + duration are both known
+            # here, so no open-span bookkeeping rides the hot path
+            _TRACER.complete(
+                "engine.step", "engine",
+                time.time() - (time.perf_counter() - t0),
+                time.perf_counter() - t0,
+                active=len(self._active), queued=len(self._queue),
+                batched=batched)
         return len(self._queue) + len(self._active)
 
     def _recover_step_fault(self, exc: BaseException):
@@ -2682,6 +2822,13 @@ class Engine:
         watchdog counts the fault; repeated faults degrade the engine
         (spec→vanilla, then admission cap halved) instead of killing it."""
         self._watchdog.note_step_fault(exc)
+        if _TRACER.enabled:
+            # flight recorder (ISSUE 18): the ring holds the last N
+            # spans/harvests before this fault — dump the postmortem
+            # BEFORE recovery rewrites the scheduler state
+            _TRACER.instant("engine.step_fault", "fault",
+                            error=type(exc).__name__, msg=str(exc)[:200])
+            _flight_record(f"step-fault-{type(exc).__name__}")
         if self._m is not None:
             self._m.recoveries.inc()
         for slot in sorted(self._active):

@@ -127,6 +127,13 @@ class Watchdog:
         self.quarantined = True
         self.quarantine_cause = cause
         self.last_fault = cause if cause is not None else self.last_fault
+        # flight recorder (ISSUE 18): quarantine is fail-stop — dump the
+        # trace ring's last-N-seconds postmortem while it still shows
+        # the steps that led here (no-op when tracing is off)
+        from ..observability.tracing import flight_record
+
+        flight_record("quarantine-"
+                      + (type(cause).__name__ if cause else "manual"))
         self._apply()
 
     # ----------------------------------------------------- state machine

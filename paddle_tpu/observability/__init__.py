@@ -33,6 +33,18 @@ from .export import (
     start_metrics_server,
     write_jsonl_snapshot,
 )
+from .tracing import (
+    TRACER,
+    Span,
+    SpanContext,
+    Tracer,
+    configure_tracing,
+    flight_record,
+    get_tracer,
+    instant,
+    span,
+    ttft_decomposition_summary,
+)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
@@ -41,6 +53,9 @@ __all__ = [
     "render_prometheus", "MetricsServer", "start_metrics_server",
     "write_jsonl_snapshot", "JsonlSink", "TBEventsBridge",
     "metric_total", "histogram_summary",
+    "Tracer", "TRACER", "Span", "SpanContext", "configure_tracing",
+    "get_tracer", "span", "instant", "flight_record",
+    "ttft_decomposition_summary",
 ]
 
 

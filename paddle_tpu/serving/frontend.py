@@ -43,6 +43,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..inference.errors import EngineError, QueueFull
+from ..observability.tracing import TRACER as _TRACER
 from .fairness import DEFAULT_TENANT, FairQueue
 
 __all__ = ["ServingFrontend", "StreamTicket"]
@@ -57,7 +58,9 @@ class StreamTicket:
                  deadline_s: Optional[float],
                  on_chunk: Optional[Callable] = None,
                  resume_tokens: Optional[List[int]] = None,
-                 max_buffered: int = 4096):
+                 max_buffered: int = 4096,
+                 trace: Optional[str] = None,
+                 t_origin: Optional[float] = None):
         self.prompt = np.asarray(prompt)
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -75,8 +78,14 @@ class StreamTicket:
         self.failure_reason: Optional[str] = None
         self.cancelled = False
         self.stall_cancelled = False
+        # request tracing (ISSUE 18): parent span context (wire string)
+        # and the ORIGINAL submit time — a migrated stream carries its
+        # first submission's clock so TTFT attribution spans replicas
+        self.trace = trace
         # host-side latency marks (the SLO loadgen's measurement side)
         self.t_submit = time.perf_counter()
+        self.t_origin = (float(t_origin) if t_origin is not None
+                         else self.t_submit)
         self.t_first: Optional[float] = None
         self.t_done: Optional[float] = None
         self._chunks: deque = deque()
@@ -290,18 +299,28 @@ class ServingFrontend:
                seed: Optional[int] = None, tenant: Optional[str] = None,
                deadline_s: Optional[float] = None,
                on_chunk: Optional[Callable] = None,
-               resume_tokens: Optional[List[int]] = None) -> StreamTicket:
+               resume_tokens: Optional[List[int]] = None,
+               trace: Optional[str] = None,
+               t_origin: Optional[float] = None) -> StreamTicket:
         """Enqueue a request (any thread). Raises the taxonomy
         ``QueueFull`` on backpressure or while draining.
         ``resume_tokens`` is the replica-migration resume path — see
-        ``Engine.add_request``."""
+        ``Engine.add_request``. ``trace``/``t_origin`` (ISSUE 18) are
+        the upstream span context and original submit time a router or
+        API caller propagates; both default to "this is the origin"."""
         if self._draining or self._stop.is_set() or self._poisoned:
             raise QueueFull("server is draining; not accepting requests")
         tenant = tenant or DEFAULT_TENANT
         ticket = StreamTicket(prompt, max_new_tokens, temperature, seed,
                               tenant, deadline_s, on_chunk=on_chunk,
                               resume_tokens=resume_tokens,
-                              max_buffered=self.max_buffered_chunks)
+                              max_buffered=self.max_buffered_chunks,
+                              trace=trace, t_origin=t_origin)
+        if _TRACER.enabled:
+            _TRACER.instant("frontend.submit", "frontend",
+                            parent=ticket.trace, tenant=tenant,
+                            prompt_len=int(ticket.prompt.size),
+                            resumed=len(resume_tokens or ()))
         # token footprint as fairness cost: a 32k-token prompt charges
         # its tenant's virtual clock accordingly
         cost = float(ticket.prompt.size + ticket.max_new_tokens)
@@ -397,13 +416,22 @@ class ServingFrontend:
             if ticket.cancelled:
                 ticket._finish("cancelled")
                 continue
+            if _TRACER.enabled:
+                # retroactive FairQueue-wait span: submit -> this pop
+                now = time.perf_counter()
+                _TRACER.complete(
+                    "frontend.queue", "frontend",
+                    time.time() - (now - ticket.t_submit),
+                    now - ticket.t_submit, parent=ticket.trace,
+                    tenant=tenant)
             try:
                 req = eng.add_request(
                     ticket.prompt, ticket.max_new_tokens,
                     on_token=ticket._on_tokens,
                     temperature=ticket.temperature, seed=ticket.seed,
                     deadline_s=ticket.deadline_s, tenant=tenant,
-                    resume_tokens=ticket.resume_tokens)
+                    resume_tokens=ticket.resume_tokens,
+                    trace=ticket.trace, t_submit=ticket.t_origin)
             except EngineError as e:
                 ticket._finish(getattr(e, "reason", "engine"))
                 continue

@@ -37,7 +37,7 @@ import numpy as np
 from .frontend import ServingFrontend
 
 __all__ = ["run_open_loop", "run_closed_loop", "bench_slo_serving",
-           "bench_failover_serving"]
+           "bench_failover_serving", "bench_trace_serving"]
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -338,6 +338,81 @@ def bench_slo_serving(cfg, on_tpu: bool) -> Dict:
         "fairness_ok": bool(0.0 < degrade < 2.0),
     })
     return out
+
+
+# -------------------------------------------------------------- tracing
+def bench_trace_serving(cfg, on_tpu: bool) -> Dict:
+    """bench.py ``bench_trace`` block (ISSUE 18 satellite): the span
+    recorder's steady-state cost as an interleaved-rep ratio of median
+    scheduling-step times, tracing ``on`` vs ``off``, on the bench_slo
+    engine geometry (multi-step decode chains + mixed chunk steps, the
+    surfaces the tentpole instrumented). Per-mode medians are floored
+    at the host jitter floor (50 ms on the single-core CPU smoke host,
+    20 ms on TPU) before the ratio; the gate is ``trace_overhead_frac``
+    (median-on / median-off - 1) < 2% with > 0 spans recorded."""
+    from ..inference.engine import Engine
+    from ..models.gpt import GPTForCausalLM
+    from ..observability import metric_total
+    from ..observability.tracing import TRACER, configure_tracing
+
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    vocab = cfg.vocab_size
+    slots = 4
+    eng = Engine(model, max_slots=slots,
+                 num_pages=(slots + 2) * cfg.max_position // 16 + 1,
+                 page_size=16, chunk_size=8 if on_tpu else 2,
+                 max_chain=2, multi_step=4)
+    rng = np.random.default_rng(21)
+
+    def workload():
+        return [eng.add_request(_mk_prompt(rng, vocab, 12, 32), 8)
+                for _ in range(slots)]
+
+    spans0 = metric_total("paddle_tpu_trace_spans_total")
+    # warmup under BOTH modes: compile every program, touch both record
+    # paths once (the enabled-guard branch and the ring append)
+    for mode in ("on", "off"):
+        configure_tracing(mode, process="bench")
+        workload()
+        eng.run()
+    # INTERLEAVED (off, on) rep pairs: back-to-back samples share the
+    # host's transient load (single-core smoke box), so the ratio is
+    # stable where sequential medians are not
+    reps, steps = 4, {"off": [], "on": []}
+    try:
+        for _ in range(reps):
+            for mode in ("off", "on"):
+                configure_tracing(mode, process="bench")
+                workload()
+                while True:
+                    t0 = time.perf_counter()
+                    live = eng.step()
+                    steps[mode].append(time.perf_counter() - t0)
+                    if not live:
+                        break
+    finally:
+        configure_tracing("off")
+        TRACER.clear()
+    floor_s = 0.020 if on_tpu else 0.050
+    med_off = float(np.median(steps["off"]))
+    med_on = float(np.median(steps["on"]))
+    ratio = max(med_on, floor_s) / max(med_off, floor_s)
+    overhead = max(0.0, ratio - 1.0)
+    spans = int(metric_total("paddle_tpu_trace_spans_total") - spans0)
+    ok = overhead < 0.02 and spans > 0
+    if not ok:
+        print(f"WARNING: bench_trace gate failed: overhead="
+              f"{overhead:.4f} (<0.02 required), spans={spans} (>0)")
+    return {
+        "trace_overhead_frac": round(overhead, 4),
+        "trace_step_ms_off": round(1e3 * med_off, 3),
+        "trace_step_ms_on": round(1e3 * med_on, 3),
+        "trace_jitter_floor_ms": 1e3 * floor_s,
+        "trace_bench_spans": spans,
+        "trace_ok": bool(ok),
+    }
 
 
 # ------------------------------------------------------------- failover

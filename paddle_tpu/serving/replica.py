@@ -60,13 +60,16 @@ class StreamSpec:
     to (re)submit it anywhere, including the resume-from-emitted state."""
 
     __slots__ = ("prompt", "max_new_tokens", "temperature", "seed",
-                 "tenant", "deadline_s", "resume_tokens")
+                 "tenant", "deadline_s", "resume_tokens", "trace",
+                 "t_origin")
 
     def __init__(self, prompt: Sequence[int], max_new_tokens: int,
                  temperature: float = 0.0, seed: Optional[int] = None,
                  tenant: Optional[str] = None,
                  deadline_s: Optional[float] = None,
-                 resume_tokens: Optional[List[int]] = None):
+                 resume_tokens: Optional[List[int]] = None,
+                 trace: Optional[str] = None,
+                 t_origin: Optional[float] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.temperature = float(temperature)
@@ -74,6 +77,12 @@ class StreamSpec:
         self.tenant = tenant
         self.deadline_s = deadline_s
         self.resume_tokens = list(resume_tokens) if resume_tokens else None
+        # request tracing (ISSUE 18): the parent SpanContext wire string
+        # and the ORIGINAL submit time (perf_counter, same-process only)
+        # — both survive a migration, so the resumed stream lands in the
+        # same trace and its TTFT placement component stays honest
+        self.trace = trace
+        self.t_origin = t_origin
 
 
 class ReplicaStream:
@@ -262,7 +271,8 @@ class InProcReplica(Replica):
             spec.prompt, spec.max_new_tokens,
             temperature=spec.temperature, seed=spec.seed,
             tenant=spec.tenant, deadline_s=spec.deadline_s,
-            on_chunk=bridge, resume_tokens=spec.resume_tokens)
+            on_chunk=bridge, resume_tokens=spec.resume_tokens,
+            trace=spec.trace, t_origin=spec.t_origin)
         return stream
 
     def _cancel(self, stream: ReplicaStream):
@@ -391,6 +401,10 @@ class SubprocessReplica(Replica):
         headers = {"Content-Type": "application/json"}
         if spec.tenant:
             headers["X-Tenant"] = spec.tenant
+        if spec.trace:
+            # trace context crosses the process boundary as a plain
+            # header (ISSUE 18) — the worker's spans join THIS trace
+            headers["X-Trace-Context"] = spec.trace
         conn = http.client.HTTPConnection(self.host, self.port)
         stream._impl = conn
         threading.Thread(

@@ -60,6 +60,8 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..observability import counter, gauge
+from ..observability.tracing import TRACER as _TRACER
+from ..observability.tracing import flight_record as _flight_record
 from ..testing.faultinject import FaultPlan
 from .replica import Replica, ReplicaStream, StreamSpec
 
@@ -94,6 +96,10 @@ class RouterTicket:
         self.t_done: Optional[float] = None
         self.last_progress = self.t_submit
         self._on_chunk = on_chunk
+        # request tracing (ISSUE 18): the trace ROOT span — minted by
+        # Router.submit, ended when the ticket reaches a terminal state;
+        # every hop (replicas included, via spec.trace) nests under it
+        self._root = None
         self._cond = threading.Condition()
         # sources authorized to deliver into this ticket. Before the
         # first chunk several may race (a hedge); the first to deliver
@@ -140,6 +146,11 @@ class RouterTicket:
             self._srcs = []
             self._primary = None
             self._cond.notify_all()
+        if self._root is not None:
+            self._root.end(tokens=len(self.tokens),
+                           migrations=self.migrations,
+                           failure=failure_reason)
+            self._root = None
         if self._on_chunk is not None:
             self._on_chunk(None)
 
@@ -320,6 +331,17 @@ class Router:
                           temperature=temperature, seed=seed,
                           tenant=tenant, deadline_s=deadline_s)
         ticket = RouterTicket(spec, on_chunk=on_chunk)
+        # trace root (ISSUE 18): minted HERE, at the outermost hop; the
+        # wire context + origin clock ride the spec through placement,
+        # hedges, and migrations, so the whole stream — both replicas
+        # of a migrated one — renders as one contiguous trace
+        spec.t_origin = ticket.t_submit
+        if _TRACER.enabled:
+            ticket._root = _TRACER.start(
+                "request", "router", tenant=tenant or "default",
+                prompt_len=len(spec.prompt),
+                max_new_tokens=int(max_new_tokens))
+            spec.trace = ticket._root.ctx.encode()
         with self._lock:
             self._tickets.add(ticket)
         self._place(ticket, resume=None, exclude=())
@@ -343,10 +365,19 @@ class Router:
         sub = StreamSpec(spec.prompt, spec.max_new_tokens,
                          temperature=spec.temperature, seed=spec.seed,
                          tenant=spec.tenant, deadline_s=spec.deadline_s,
-                         resume_tokens=resume)
+                         resume_tokens=resume,
+                         # same trace + origin clock on every
+                         # (re)placement: a migrated stream's spans on
+                         # the new replica join the ORIGINAL trace
+                         trace=spec.trace, t_origin=spec.t_origin)
+        place = _TRACER.start(
+            "router.place", "router", parent=spec.trace,
+            resumed=len(resume or ())) if _TRACER.enabled else None
         last_exc: Optional[BaseException] = None
         for attempt in range(self.max_place_attempts):
             if ticket.done:
+                if place is not None:
+                    place.end(outcome="ticket-done", attempts=attempt)
                 return
             if attempt:
                 # backoff between attempts; the first try is immediate
@@ -375,7 +406,12 @@ class Router:
                 stream.cancel()
                 ticket._detach(stream)
                 continue
+            if place is not None:
+                place.end(outcome="placed", replica=rep.name,
+                          attempts=attempt + 1)
             return
+        if place is not None:
+            place.end(outcome="failed", attempts=self.max_place_attempts)
         self._fail(ticket, REPLICA_LOST, last_exc)
 
     def _fail(self, ticket: RouterTicket, reason: str,
@@ -426,6 +462,12 @@ class Router:
             return
         ticket.migrations += 1
         self._m_migrations.inc()
+        if _TRACER.enabled:
+            _TRACER.instant("router.migrate", "router",
+                            parent=ticket.spec.trace,
+                            from_replica=stream.replica.name,
+                            why=why, emitted=len(resume),
+                            migration=ticket.migrations)
         # make sure the old upstream can't keep emitting into a client
         # the new one now owns (harmless for a dead replica, essential
         # for a heartbeat-dropped one that is secretly still alive)
@@ -483,6 +525,10 @@ class Router:
                     quarantined = False
                 if quarantined:
                     self._m_quarantines.inc()
+                    if _TRACER.enabled:
+                        _TRACER.instant("router.quarantine", "fault",
+                                        replica=rep.name)
+                        _flight_record(f"replica-quarantine-{rep.name}")
                     rep.kill()
                     up = False
             with self._lock:
@@ -493,6 +539,14 @@ class Router:
                 if up and was_dead and idx not in self._restarting:
                     self._dead.pop(idx, None)
             if newly_dead:
+                if _TRACER.enabled:
+                    # crash postmortem (ISSUE 18): for in-process
+                    # replicas the shared ring still holds the victim's
+                    # last decode steps — dump BEFORE migration churn
+                    # overwrites them
+                    _TRACER.instant("router.replica_dead", "fault",
+                                    replica=rep.name)
+                    _flight_record(f"replica-dead-{rep.name}")
                 self._migrate_replica(rep)
             if not up and self.restart_dead:
                 # (re)schedule the supervised restart: also re-arms
@@ -547,6 +601,9 @@ class Router:
             return
         ticket.hedged = True
         self._m_hedges.inc()
+        if _TRACER.enabled:
+            _TRACER.instant("router.hedge", "router",
+                            parent=ticket.spec.trace, replica=rep.name)
         stream = rep.prepare(ticket.spec, self._on_chunk,
                              self._on_done, self._on_broken)
         stream._ticket = ticket

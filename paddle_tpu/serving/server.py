@@ -221,6 +221,21 @@ class ApiServer:
             return await self._send(writer, 200, {
                 "object": "list",
                 "data": [{"id": self.model_name, "object": "model"}]})
+        if method == "GET" and path == "/debug/trace":
+            # live trace scrape (ISSUE 18): the tracer ring, oldest
+            # first — tools/trace_tpu.py converts it to Chrome
+            # trace-event JSON. Served only in mode "on" (flight-only
+            # records for postmortems but doesn't expose a live feed).
+            from ..observability.tracing import TRACER
+            if not TRACER.live:
+                return await self._send(writer, 404, _err(
+                    "tracing_off",
+                    f"tracing mode is {TRACER.mode!r}; start with "
+                    "--trace on to serve live snapshots"))
+            return await self._send(writer, 200, {
+                "mode": TRACER.mode, "process": TRACER.process,
+                "capacity": TRACER.capacity,
+                "records": TRACER.snapshot()})
         if method == "POST" and path in ("/v1/completions",
                                          "/v1/chat/completions"):
             try:
@@ -279,6 +294,11 @@ class ApiServer:
             return await self._send(writer, 400,
                                     _err("validation", str(e)))
         tenant = headers.get("x-tenant") or payload.get("user") or None
+        # trace propagation (ISSUE 18): a router/client that carries a
+        # span context sends it as a header; the engine's spans for this
+        # request then join the CALLER's trace (how a subprocess
+        # replica's half of a migrated stream stays contiguous)
+        trace = headers.get("x-trace-context") or None
         max_tokens = int(payload.get("max_tokens",
                                      self.default_max_tokens))
         temperature = float(payload.get("temperature", 0.0))
@@ -304,7 +324,7 @@ class ApiServer:
                 tenant=tenant,
                 deadline_s=(float(deadline_ms) / 1e3
                             if deadline_ms is not None else None),
-                on_chunk=on_chunk, resume_tokens=resume)
+                on_chunk=on_chunk, resume_tokens=resume, trace=trace)
         except QueueFull as e:
             # backpressure carries a when-to-come-back hint (ISSUE 13
             # satellite): derived from the depth of the queue the

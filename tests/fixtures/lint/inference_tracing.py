@@ -1,0 +1,67 @@
+"""TPL1401 fixtures: tracing calls inside jit-traced regions. The
+filename carries "inference" so the path-restricted rule engages (the
+real targets are paddle_tpu/{inference,ops}/ modules). A span opened
+under trace measures COMPILATION, not execution; an instant records one
+event for the compiled program's whole lifetime; tensor-derived args
+are tracers the ring cannot hold. Tracing is host telemetry (ISSUE 18):
+record between dispatches, or return the value and record at harvest."""
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import observability
+from paddle_tpu.observability import counter
+from paddle_tpu.observability.tracing import TRACER, instant, span
+
+
+@jax.jit
+def traced_span_ctx(x):
+    with span("decode.chunk", "engine"):  # EXPECT: TPL1401
+        return x * 2
+
+
+@jax.jit
+def traced_instant(x):
+    y = jnp.sum(x)
+    instant("engine.harvest", "engine", fresh=1)  # EXPECT: TPL1401
+    return y
+
+
+@jax.jit
+def traced_tracer_object(x):
+    TRACER.instant("engine.step", "engine")  # EXPECT: TPL1401
+    return x + 1
+
+
+@jax.jit
+def traced_pkg_attr(x):
+    # the package re-export roots at an observability alias, but the
+    # call is the TRACING api — the specific rule outranks TPL601
+    observability.span("prefill.wave", "engine")  # EXPECT: TPL1401
+    return x - 1
+
+
+@jax.jit
+def traced_metrics_still_601(x):
+    # a plain METRICS call under trace keeps its own diagnosis
+    counter("fixture_bad_total", "under trace").inc()  # EXPECT: TPL601
+    return x * 3
+
+
+@jax.jit
+def traced_suppressed(x):
+    # counting compiles via a trace-time instant is the POINT here
+    # tpulint: disable=TPL1401 -- fixture: deliberate trace-time event
+    instant("compile.trace", "jit")  # EXPECT-SUPPRESSED: TPL1401
+    return x - 2
+
+
+def host_side_scheduler(xs):
+    """Tracing between dispatches — the supported pattern."""
+    total = 0.0
+    with span("engine.step", "engine") as s:
+        for x in xs:
+            y = traced_metrics_still_601(x)
+            instant("engine.harvest", "engine", fresh=1)
+            total += float(jax.device_get(y).sum())
+        s.set(total=total)
+    return total

@@ -100,7 +100,7 @@ class TestRegistry:
             "TPL101", "TPL102", "TPL201", "TPL301", "TPL302", "TPL303",
             "TPL304", "TPL401", "TPL402", "TPL501", "TPL502", "TPL503",
             "TPL601", "TPL701", "TPL702", "TPL801", "TPL901", "TPL902",
-            "TPL1002", "TPL1101", "TPL1201", "TPL1301",
+            "TPL1002", "TPL1101", "TPL1201", "TPL1301", "TPL1401",
         }
         for r in RULES.values():
             assert r.description and r.name and r.family

@@ -21,6 +21,16 @@
 lint:
 	python tools/lint_tpu.py paddle_tpu examples tools --fail-on-violation
 
+# races — tpurace cross-module thread-ownership analysis (ISSUE 19):
+#         discover thread domains (engine / kv-spill worker / router
+#         monitor / SSE readers / asyncio), check per-class attribute
+#         write sets across them (TPL1501-TPL1504), fail on any live
+#         finding — and on suppression creep past the audited count.
+#         Pure stdlib, no jax import; gates `test` like lint.
+races:
+	python tools/race_tpu.py paddle_tpu --fail-on-violation \
+		--max-suppressions 8
+
 analyze:
 	JAX_PLATFORMS=cpu python tools/analyze_tpu.py --fail-on-violation \
 		--mesh 1 --mesh 4 --mesh 8
@@ -42,7 +52,7 @@ chaos:
 		tests/test_moe_serving.py tests/test_multi_step.py \
 		tests/test_api_server.py tests/test_replica_failover.py \
 		tests/test_integrity.py tests/test_kv_tier.py \
-		tests/test_tracing.py -q
+		tests/test_tracing.py tests/test_ownership.py -q
 
 # chaos-serve — the multi-replica failover suite alone (ISSUE 13):
 # SIGKILL/poison a replica mid-stream, assert every client stream
@@ -89,7 +99,7 @@ trace-smoke:
 		--out /tmp/paddle_tpu_trace_chrome.json
 	python tools/trace_tpu.py --check /tmp/paddle_tpu_trace_chrome.json
 
-test: lint analyze plan chaos trace-smoke
+test: lint races analyze plan chaos trace-smoke
 	python -m pytest tests/ -x -q --ignore=tests/onchip
 
 onchip:
@@ -98,5 +108,5 @@ onchip:
 bench:
 	python bench.py
 
-.PHONY: lint analyze plan chaos chaos-serve chaos-integrity chaos-tier \
-	serve-smoke trace-smoke test onchip bench
+.PHONY: lint races analyze plan chaos chaos-serve chaos-integrity \
+	chaos-tier serve-smoke trace-smoke test onchip bench

@@ -453,6 +453,23 @@ def bench_trace(cfg, on_tpu):
         return {"trace_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_ownership(cfg, on_tpu):
+    """Runtime ownership-guard scenario (ISSUE 19): the guard's
+    steady-state cost — every hot-path attribute write on a fully
+    guarded tiered engine paying the __setattr__ interception — as an
+    interleaved-rep ratio of median scheduling-step times, armed vs
+    disarmed. Gate: <2% median step overhead over the 50 ms single-core
+    jitter floor; an OwnershipError anywhere surfaces as a bench error
+    (a finishing run is the clean-tree runtime proof at bench
+    geometry)."""
+    try:
+        from paddle_tpu.serving.loadgen import bench_ownership_serving
+
+        return bench_ownership_serving(cfg, on_tpu)
+    except Exception as e:
+        return {"ownership_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_integrity(cfg, on_tpu):
     """Data-integrity scenario (ISSUE 14): the online-audit layer's
     steady-state cost — weight-shard audits, per-page KV checksums at
@@ -748,6 +765,7 @@ def main():
     failover = bench_failover(decode_cfg, on_tpu)
     integrity = bench_integrity(decode_cfg, on_tpu)
     trace = bench_trace(decode_cfg, on_tpu)
+    ownership = bench_ownership(decode_cfg, on_tpu)
     resume = bench_resume(on_tpu)
     multichip = bench_multichip()
     plan = bench_plan(multichip)
@@ -879,6 +897,11 @@ def main():
         "trace_spans_total": int(
             metric_total("paddle_tpu_trace_spans_total")),
         "trace_overhead_frac": trace.get("trace_overhead_frac", 0.0),
+        # thread-ownership guard surface (ISSUE 19): the runtime twin
+        # of `make races` — armed-vs-disarmed step overhead on a fully
+        # guarded tiered engine, gated <2% like bench_trace
+        "ownership_guard_overhead_frac": ownership.get(
+            "ownership_guard_overhead_frac", 0.0),
         # training-resilience surface (ISSUE 7): checkpoint commits and
         # the in-loop guard counters as the registry saw them
         "train_checkpoints": int(
@@ -941,6 +964,7 @@ def main():
         **failover,
         **integrity,
         **trace,
+        **ownership,
         **resume,
         **multichip,
         "metrics": metrics_block,

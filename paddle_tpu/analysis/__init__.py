@@ -10,15 +10,33 @@ or programmatically:
     result = lint_paths(["paddle_tpu", "examples"])
     assert not result.violations
 
+tpurace (ISSUE 19, also pure stdlib) extends the static side across
+modules: thread-domain discovery + per-class attribute read/write census
+over each domain's reachable call graph, reporting the TPL1500
+thread-ownership family. ``lint_source`` folds in each file's slice;
+the full cross-module sweep is ``make races`` / ``tools/race_tpu.py``
+(:func:`analyze_paths`).
+
 Runtime side: :func:`leak_guard` arms ``jax.check_tracer_leaks`` around a
-compiled-path entry (opt-in via ``PADDLE_TPU_CHECK_TRACERS=1``).
+compiled-path entry (opt-in via ``PADDLE_TPU_CHECK_TRACERS=1``);
+:func:`ownership_guard` + :func:`guard_engine` arm cross-thread write
+detection on the serving stack's shared objects (opt-in via
+``PADDLE_TPU_CHECK_OWNERSHIP=1``), raising :class:`OwnershipError` where
+tpurace's TPL1501 would point.
 """
 from .linter import LintResult, Violation, lint_file, lint_paths, lint_source  # noqa: F401
+from .ownership import OwnershipReport, analyze_paths, analyze_sources  # noqa: F401
 from .rules import FAMILIES, RULES, Rule  # noqa: F401
-from .runtime import TracerLeakError, leak_guard, tracer_checks_enabled  # noqa: F401
+from .runtime import (  # noqa: F401
+    OwnershipError, TracerLeakError, guard_engine, guard_object,
+    leak_guard, ownership_checks_enabled, ownership_guard, thread_domain,
+    tracer_checks_enabled)
 
 __all__ = [
     "LintResult", "Violation", "lint_file", "lint_paths", "lint_source",
     "RULES", "Rule", "FAMILIES",
+    "OwnershipReport", "analyze_paths", "analyze_sources",
     "leak_guard", "tracer_checks_enabled", "TracerLeakError",
+    "ownership_guard", "ownership_checks_enabled", "OwnershipError",
+    "guard_object", "guard_engine", "thread_domain",
 ]

@@ -39,6 +39,13 @@ __all__ = ["Violation", "LintResult", "lint_source", "lint_file", "lint_paths"]
 
 # --------------------------------------------------------------------- model
 
+# Suppression-comment grammar, shared by every analysis pass (tpulint's
+# per-file rules here, tpurace's cross-module TPL15xx in ownership.py):
+#   # tpulint: disable=TPL123[,TPL456] -- one-line justification
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+?)"
+    r"(?:\s*(?:--+|—)\s*(?P<reason>.*))?\s*$")
+
 
 @dataclass
 class Violation:
@@ -1407,9 +1414,7 @@ class _ModuleAnalyzer:
 
     # -- suppression ---------------------------------------------------------
 
-    _SUPPRESS_RE = re.compile(
-        r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+?)"
-        r"(?:\s*(?:--+|—)\s*(?P<reason>.*))?\s*$")
+    _SUPPRESS_RE = _SUPPRESS_RE  # module-level grammar, shared with tpurace
 
     def _suppressions_for_line(self, line_no: int):
         """Codes suppressed at 1-based line ``line_no``: a disable comment on
@@ -1496,13 +1501,20 @@ def _target_names(t: ast.AST) -> List[str]:
 
 def lint_source(source: str, path: str = "<string>") -> List[Violation]:
     """Lint one source string. Returns ALL violations, including suppressed
-    ones (check ``.suppressed``)."""
+    ones (check ``.suppressed``). Includes the per-file slice of the
+    tpurace thread-ownership pass (TPL15xx) — the cross-module sweep is
+    ``make races`` / ``tools/race_tpu.py``."""
     try:
         analyzer = _ModuleAnalyzer(path, source)
     except SyntaxError as e:
         return [Violation("TPL000", path, e.lineno or 1, e.offset or 0,
                           f"syntax-error: {e.msg}")]
-    return analyzer.run()
+    out = analyzer.run()
+    # lazy: ownership imports Violation/_SUPPRESS_RE from this module
+    from . import ownership
+    out.extend(ownership.analyze_sources({path: source}).violations)
+    out.sort(key=lambda v: (v.line, v.col, v.rule))
+    return out
 
 
 def lint_file(path: str) -> List[Violation]:

@@ -1,0 +1,1018 @@
+"""tpurace — cross-module thread-ownership & race analysis (ISSUE 19).
+
+The serving stack runs at least five concurrent domains — the engine
+thread (``ServingFrontend._loop``), the kv-tier spill worker
+(``HostTier._worker_loop``), the router supervisor and its restart
+threads, per-stream SSE reader threads, and the asyncio event loop —
+and until this pass, every ownership rule ("one engine thread", "the
+worker communicates exclusively through the job queue and the
+completion deque") lived only in comments. tpurace turns the documented
+discipline into a machine-checked invariant, the way TPL702/TPL902/
+TPL1101 froze earlier disciplines:
+
+1. **Domain discovery.** Thread entrypoints are found structurally:
+   ``threading.Thread(target=f, name="...")`` sites (the ``name=``
+   literal names the domain — so both spawn sites naming
+   ``paddle-engine-core`` land in ONE domain), ``loop.run_in_executor``
+   hand-offs, every ``async def`` (the ``asyncio`` domain), a small
+   table of known engine-thread roots, and the explicit
+   ``@thread_domain("...")`` decorator (``analysis.runtime``) for
+   anything discovery misses. Everything unreachable from any root
+   belongs to the implicit ``caller`` domain (the submitter/test
+   thread).
+2. **Reachability.** Each domain's intra-package call graph is closed
+   over: ``self.m()``, calls through attributes/locals/parameters whose
+   class is known (``self.tier = HostTier(...)``, annotations,
+   ``x = Engine(...)``), bare calls to module/nested/imported package
+   functions, and bound-method REFERENCES handed off as callbacks
+   (``on_token=ticket._on_tokens`` makes ``_on_tokens`` reachable from
+   the passing domain — the engine thread calls it later).
+3. **Attribute census.** For every reached function, per-class
+   attribute reads and writes are collected with the set of locks
+   lexically held (``with self._lock:`` / ``with self._cond:``), then
+   the TPL1500 family is checked over the cross-domain view (rules.py
+   has the full statements):
+
+   * **TPL1501** ``cross-thread-write-without-channel``
+   * **TPL1502** ``lock-order-inversion``
+   * **TPL1503** ``unsynchronized-check-then-act``
+   * **TPL1504** ``event-loop-state-from-thread``
+
+Sanctioned channels — the accesses that are *supposed* to cross
+domains and therefore never flag: ``queue.Queue`` put/get, GIL-atomic
+``deque`` append/popleft, ``threading.Event`` set/wait, and any write
+set where one ``Lock``/``RLock``/``Condition`` is held at every site.
+Constructor writes (``__init__``/``__new__``/``__post_init__``) never
+conflict: construct-then-publish is the idiom, and the runtime twin
+(``ownership_guard``) likewise stamps owners only after hand-off.
+
+Honest limits (tpurace is a LINTER, not a verifier — it under- and
+over-approximates on purpose, and the escape hatch is the same
+``# tpulint: disable=TPL15xx -- reason`` comment tpulint uses):
+
+* **No aliasing.** Receivers are typed only through direct evidence —
+  ``self``, annotated parameters, ``x = ClassName(...)`` locals,
+  ``self.attr = ClassName(...)`` fields. A callable or object that
+  travels through an untyped container/argument is invisible.
+* **Intra-package only.** Only the files handed to one analysis call
+  participate; stdlib/third-party internals are trusted. Per-file mode
+  (how ``lint_source`` embeds this pass) sees strictly less than the
+  package-level ``make races`` sweep.
+* **Lexical locks.** Only ``with <lock-attr>:`` counts as holding;
+  bare ``acquire()``/``release()`` pairs and locks passed across
+  functions are not tracked.
+* **Declared escape.** ``@thread_domain("name")`` asserts a root the
+  discovery cannot see (a callback registered with a C extension, a
+  signal handler); the decorator is a runtime no-op.
+
+Pure stdlib — importing this module must never pull in jax.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from . import rules as R
+from .linter import LintResult, Violation, _iter_py_files
+
+__all__ = ["analyze_sources", "analyze_paths", "analyze_file",
+           "OwnershipReport", "main"]
+
+
+# ------------------------------------------------------------- vocabulary
+
+# Constructor tail names that type an attribute as a synchronization
+# object. Locks sanction a write set when ONE of them is held at every
+# write; channels/events are sanctioned through their method surface
+# (put/get/append/popleft/set/wait are calls, not attribute writes).
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_CHANNEL_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                  "deque"}
+_EVENT_CTORS = {"Event", "Semaphore", "BoundedSemaphore", "Barrier"}
+_SYNC_CTORS = _LOCK_CTORS | _CHANNEL_CTORS | _EVENT_CTORS
+
+_CTOR_FUNCS = {"__init__", "__new__", "__post_init__"}
+
+# Known engine-thread roots (ISSUE 19): belt-and-braces for the domains
+# the serving stack documents in prose. Discovery finds these through
+# their Thread(target=..., name=...) spawn sites too; the table keeps
+# the domain identity stable even in per-file mode, where the spawn
+# site may live in a different module than the loop body.
+_KNOWN_ROOTS = {
+    ("ServingFrontend", "_loop"): "paddle-engine-core",
+    ("HostTier", "_worker_loop"): "paddle-kv-spill",
+    ("Router", "_monitor_loop"): "paddle-router-monitor",
+}
+
+_CALLER = "caller"
+_ASYNCIO = "asyncio"
+
+# same comment grammar as tpulint (linter._SUPPRESS_RE is the source of
+# truth; re-stated here to keep this module importable standalone)
+from .linter import _SUPPRESS_RE  # noqa: E402
+
+
+def _tail(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call):
+        return _tail(node.func)
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# ------------------------------------------------------------------ model
+
+
+@dataclass
+class _FuncInfo:
+    qname: str                       # "module::Class.method" / "module::f"
+    module: str
+    cls: Optional[str]               # simple class name or None
+    name: str
+    node: ast.AST
+    is_async: bool = False
+    declared_domains: List[str] = field(default_factory=list)
+    # resolved call/ref edges (callee qnames)
+    edges: Set[str] = field(default_factory=set)
+    # direct calls with the lock set held at the call site — feeds the
+    # entry-lock propagation (the ``_locked``-suffix convention: the
+    # CALLER holds the lock, the callee's writes are still protected)
+    call_sites: List[Tuple[str, frozenset]] = field(default_factory=list)
+    # calls made while holding locks: (callee_qname, frozenset(held))
+    locked_calls: List[Tuple[str, frozenset, int, int]] = field(
+        default_factory=list)
+    # lock keys acquired lexically anywhere in the function
+    acquires: Set[Tuple[str, str]] = field(default_factory=set)
+    calls_soon_threadsafe: bool = False
+
+
+@dataclass
+class _ClassInfo:
+    qname: str                       # "module::Class"
+    name: str
+    module: str
+    methods: Dict[str, _FuncInfo] = field(default_factory=dict)
+    attr_types: Dict[str, str] = field(default_factory=dict)  # attr -> ctor
+
+    def attr_is(self, attr: str, ctors: Set[str]) -> bool:
+        return self.attr_types.get(attr) in ctors
+
+
+@dataclass
+class _Access:
+    cls: str                         # class qname
+    attr: str
+    write: bool
+    path: str
+    line: int
+    col: int
+    func: str                        # accessing function qname
+    in_ctor: bool
+    locks: frozenset                 # (class_qname, lock_attr) held
+
+
+@dataclass
+class _SpawnSite:
+    target_qname: str
+    domain: str
+    path: str
+    line: int
+
+
+@dataclass
+class OwnershipReport:
+    """Cross-module analysis result: the violations plus the discovered
+    domain map (``domains`` is domain name -> sorted root qnames — what
+    ``race_tpu.py --show-domains`` prints)."""
+    violations: List[Violation] = field(default_factory=list)
+    domains: Dict[str, List[str]] = field(default_factory=dict)
+    files_scanned: int = 0
+
+
+# -------------------------------------------------------------- collector
+
+
+class _ModuleCollector(ast.NodeVisitor):
+    """Pass 1 over one module: classes (methods + attribute ctor types),
+    module/nested functions, and the import map for cross-module call
+    resolution."""
+
+    def __init__(self, module: str, tree: ast.Module):
+        self.module = module
+        self.classes: Dict[str, _ClassInfo] = {}      # simple name -> info
+        self.functions: Dict[str, _FuncInfo] = {}     # qname -> info
+        self.by_local_name: Dict[str, str] = {}       # bare name -> qname
+        self.imports: Dict[str, str] = {}             # local name -> source
+        self.has_asyncio = False
+        self._walk_module(tree)
+
+    def _walk_module(self, tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_import(node)
+            elif isinstance(node, ast.ClassDef):
+                self._collect_class(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_func(node, cls=None, prefix="")
+
+    def _collect_import(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[0] == "asyncio":
+                    self.has_asyncio = True
+        else:
+            if (node.module or "").split(".")[0] == "asyncio":
+                self.has_asyncio = True
+            for a in node.names:
+                self.imports[a.asname or a.name] = a.name
+
+    def _collect_class(self, node: ast.ClassDef):
+        ci = _ClassInfo(qname=f"{self.module}::{node.name}",
+                        name=node.name, module=self.module)
+        self.classes[node.name] = ci
+        for item in node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = self._collect_func(item, cls=node.name,
+                                        prefix=f"{node.name}.")
+                ci.methods[item.name] = fi
+        # attribute ctor types from every method body (first write wins)
+        for fi in ci.methods.values():
+            for sub in ast.walk(fi.node):
+                tgt = None
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                    tgt, val = sub.targets[0], sub.value
+                elif isinstance(sub, ast.AnnAssign) and sub.value is not None:
+                    tgt, val = sub.target, sub.value
+                else:
+                    continue
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and isinstance(val, ast.Call)):
+                    ctor = _tail(val)
+                    if ctor and tgt.attr not in ci.attr_types:
+                        ci.attr_types[tgt.attr] = ctor
+
+    def _collect_func(self, node, cls: Optional[str], prefix: str
+                      ) -> _FuncInfo:
+        qname = f"{self.module}::{prefix}{node.name}"
+        fi = _FuncInfo(qname=qname, module=self.module, cls=cls,
+                       name=node.name, node=node,
+                       is_async=isinstance(node, ast.AsyncFunctionDef))
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Call) and _tail(dec) == "thread_domain" \
+                    and dec.args \
+                    and isinstance(dec.args[0], ast.Constant) \
+                    and isinstance(dec.args[0].value, str):
+                fi.declared_domains.append(dec.args[0].value)
+        self.functions[qname] = fi
+        if cls is None:
+            self.by_local_name[node.name] = qname
+        # nested functions (thread targets like `pump`, `killer`)
+        for sub in ast.walk(node):
+            if sub is node:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and not any(sub in ast.walk(other.node)
+                                for other in list(self.functions.values())
+                                if other.node is not node
+                                and other.node is not sub):
+                nested_q = f"{qname}.{sub.name}"
+                if nested_q not in self.functions:
+                    nfi = _FuncInfo(
+                        qname=nested_q, module=self.module, cls=cls,
+                        name=sub.name, node=sub,
+                        is_async=isinstance(sub, ast.AsyncFunctionDef))
+                    self.functions[nested_q] = nfi
+        return fi
+
+
+# --------------------------------------------------------------- analyzer
+
+
+class _Analyzer:
+    """Pass 2+: cross-module resolution, domain reachability, attribute
+    census, TPL1500 checks."""
+
+    def __init__(self, sources: Dict[str, str]):
+        self.sources = sources
+        self.lines: Dict[str, List[str]] = {}
+        self.collectors: Dict[str, _ModuleCollector] = {}   # module -> c
+        self.mod_of_path: Dict[str, str] = {}
+        self.path_of_mod: Dict[str, str] = {}
+        self.violations: List[Violation] = []
+        self.accesses: List[_Access] = []
+        self.spawns: List[_SpawnSite] = []
+        self.check_then_act: List[Tuple[_Access, str]] = []
+        # functions whose reference escapes (callback hand-off, thread
+        # target): unknown callers, so they never earn entry locks or
+        # ctor-only status from the call sites we CAN see
+        self._escaped: Set[str] = set()
+        self.files_scanned = 0
+        # global registries
+        self.classes_by_name: Dict[str, List[_ClassInfo]] = {}
+        self.methods_by_name: Dict[str, List[_FuncInfo]] = {}
+        self.funcs: Dict[str, _FuncInfo] = {}
+        self._parse_all()
+        self._index()
+
+    # ------------------------------------------------------------ parsing
+    def _parse_all(self):
+        for path, src in sorted(self.sources.items()):
+            mod = os.path.splitext(os.path.basename(path))[0]
+            # disambiguate basename collisions (pkg/a/util.py, pkg/b/util.py)
+            if mod in self.path_of_mod:
+                mod = os.path.splitext(path)[0].replace(os.sep, ".")
+            try:
+                tree = ast.parse(src)
+            except SyntaxError:
+                continue  # tpulint reports TPL000 for this file
+            self.files_scanned += 1
+            self.lines[path] = src.splitlines()
+            self.mod_of_path[path] = mod
+            self.path_of_mod[mod] = path
+            self.collectors[mod] = _ModuleCollector(mod, tree)
+
+    def _index(self):
+        for c in self.collectors.values():
+            for ci in c.classes.values():
+                self.classes_by_name.setdefault(ci.name, []).append(ci)
+                for m in ci.methods.values():
+                    self.methods_by_name.setdefault(m.name, []).append(m)
+            self.funcs.update(c.functions)
+
+    # --------------------------------------------------------- resolution
+    def _class_named(self, name: str) -> Optional[_ClassInfo]:
+        cands = self.classes_by_name.get(name, [])
+        return cands[0] if len(cands) == 1 else None
+
+    def _ann_class(self, ann: Optional[ast.AST]) -> Optional[_ClassInfo]:
+        if ann is None:
+            return None
+        t = _tail(ann)
+        if t is None and isinstance(ann, ast.Constant) \
+                and isinstance(ann.value, str):
+            t = ann.value.split(".")[-1].strip("'\" ")
+        return self._class_named(t) if t else None
+
+    def _type_env(self, fi: _FuncInfo, c: _ModuleCollector
+                  ) -> Dict[str, _ClassInfo]:
+        """Local-name -> class map for one function: ``self``, annotated
+        parameters, ``x = ClassName(...)`` / ``x = self.attr`` locals.
+        Closure variables of nested functions inherit the enclosing
+        function's bindings (outer names resolved first)."""
+        env: Dict[str, _ClassInfo] = {}
+        # enclosing-function bindings for nested defs
+        if "." in fi.qname.split("::", 1)[1]:
+            outer_q = fi.qname.rsplit(".", 1)[0]
+            outer = self.funcs.get(outer_q)
+            if outer is not None and outer is not fi:
+                env.update(self._type_env(outer, c))
+        if fi.cls is not None:
+            own = c.classes.get(fi.cls)
+            if own is not None:
+                env["self"] = own
+        args = fi.node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)):
+            hit = self._ann_class(a.annotation)
+            if hit is not None:
+                env[a.arg] = hit
+        for sub in ast.walk(fi.node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                    and isinstance(sub.targets[0], ast.Name):
+                name, val = sub.targets[0].id, sub.value
+                if isinstance(val, ast.Call):
+                    hit = self._class_named(_tail(val) or "")
+                    if hit is not None:
+                        env[name] = hit
+                elif isinstance(val, ast.Attribute) \
+                        and isinstance(val.value, ast.Name) \
+                        and val.value.id in env:
+                    owner = env[val.value.id]
+                    hit = self._class_named(
+                        owner.attr_types.get(val.attr, ""))
+                    if hit is not None:
+                        env[name] = hit
+        return env
+
+    def _recv_class(self, node: ast.AST, env: Dict[str, _ClassInfo]
+                    ) -> Optional[_ClassInfo]:
+        """Class of the receiver expression of an attribute access."""
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Attribute):
+            owner = self._recv_class(node.value, env)
+            if owner is not None:
+                return self._class_named(
+                    owner.attr_types.get(node.attr, ""))
+        return None
+
+    def _resolve_callable(self, node: ast.AST, fi: _FuncInfo,
+                          env: Dict[str, _ClassInfo],
+                          unique_fallback: bool = False
+                          ) -> Optional[_FuncInfo]:
+        """Function a Name/Attribute expression denotes, or None."""
+        c = self.collectors[fi.module]
+        if isinstance(node, ast.Name):
+            # nested def in this (or an enclosing) function?
+            scope_q = fi.qname
+            while True:
+                cand = self.funcs.get(f"{scope_q}.{node.id}")
+                if cand is not None:
+                    return cand
+                body = scope_q.split("::", 1)[1]
+                if "." not in body:
+                    break
+                scope_q = scope_q.rsplit(".", 1)[0]
+            q = c.by_local_name.get(node.id)
+            if q is not None:
+                return self.funcs.get(q)
+            src = c.imports.get(node.id)
+            if src is not None:
+                src_mod = src.split(".")[-1]
+                other = self.collectors.get(src_mod)
+                if other is not None:
+                    return self.funcs.get(other.by_local_name.get(node.id))
+                # "from .mod import name" binds the NAME, module is src
+                for other in self.collectors.values():
+                    hit = other.by_local_name.get(node.id)
+                    if hit is not None:
+                        return self.funcs.get(hit)
+            return None
+        if isinstance(node, ast.Attribute):
+            recv = self._recv_class(node.value, env)
+            if recv is not None:
+                return recv.methods.get(node.attr)
+            if unique_fallback:
+                cands = self.methods_by_name.get(node.attr, [])
+                if len(cands) == 1:
+                    return cands[0]
+        return None
+
+    # ----------------------------------------------------- function walk
+    def _lock_key(self, node: ast.AST, env: Dict[str, _ClassInfo]
+                  ) -> Optional[Tuple[str, str]]:
+        """(class_qname, attr) if ``node`` is a lock-typed attribute."""
+        if isinstance(node, ast.Attribute):
+            recv = self._recv_class(node.value, env)
+            if recv is not None and recv.attr_is(node.attr, _LOCK_CTORS):
+                return (recv.qname, node.attr)
+        return None
+
+    def _walk_function(self, fi: _FuncInfo, path: str):
+        c = self.collectors[fi.module]
+        env = self._type_env(fi, c)
+        in_ctor = fi.name in _CTOR_FUNCS
+        thread_target_refs: Set[int] = set()
+        call_func_nodes: Set[int] = set()  # the f in f(...): not a ref
+
+        def is_thread_spawn(call: ast.Call) -> Optional[ast.AST]:
+            t = _tail(call.func)
+            if t == "Thread":
+                for kw in call.keywords:
+                    if kw.arg == "target":
+                        return kw.value
+                if call.args:  # Thread(group, target) — rare, positional
+                    return call.args[1] if len(call.args) > 1 else None
+            if t == "run_in_executor" and len(call.args) >= 2:
+                return call.args[1]
+            return None
+
+        def spawn_domain(call: ast.Call, target_fi: _FuncInfo) -> str:
+            for kw in call.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    return kw.value.value
+            return f"thread:{target_fi.name}"
+
+        def visit(node, held: frozenset):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fi.node:
+                return  # nested defs are separate _FuncInfos
+            if isinstance(node, ast.With) or isinstance(node, ast.AsyncWith):
+                inner = set(held)
+                for item in node.items:
+                    key = self._lock_key(item.context_expr, env)
+                    if key is not None:
+                        fi.acquires.add(key)
+                        # lock-order edge: every lock already held -> key
+                        for outer in held:
+                            if outer != key:
+                                self._lock_edges.setdefault(
+                                    (outer, key), (path, node.lineno,
+                                                   node.col_offset))
+                        inner.add(key)
+                for item in node.items:
+                    visit(item.context_expr, held)
+                for ch in node.body:
+                    visit(ch, frozenset(inner))
+                return
+            if isinstance(node, ast.Call):
+                tgt = is_thread_spawn(node)
+                if tgt is not None:
+                    thread_target_refs.add(id(tgt))
+                    tfi = self._resolve_callable(tgt, fi, env,
+                                                 unique_fallback=True)
+                    if tfi is not None:
+                        self.spawns.append(_SpawnSite(
+                            target_qname=tfi.qname,
+                            domain=spawn_domain(node, tfi),
+                            path=path, line=node.lineno))
+                if _tail(node.func) == "call_soon_threadsafe":
+                    fi.calls_soon_threadsafe = True
+                    # the handed-off callable runs ON the event loop:
+                    # root it in the asyncio domain instead of drawing
+                    # a call edge from this (thread) domain
+                    for arg in node.args:
+                        afi = self._resolve_callable(arg, fi, env)
+                        if afi is not None:
+                            thread_target_refs.add(id(arg))
+                            self.spawns.append(_SpawnSite(
+                                target_qname=afi.qname, domain=_ASYNCIO,
+                                path=path, line=node.lineno))
+                call_func_nodes.add(id(node.func))
+                callee = self._resolve_callable(node.func, fi, env)
+                if callee is not None:
+                    fi.edges.add(callee.qname)
+                    fi.call_sites.append((callee.qname, held))
+                    if held:
+                        fi.locked_calls.append(
+                            (callee.qname, held, node.lineno,
+                             node.col_offset))
+                for ch in ast.iter_child_nodes(node):
+                    visit(ch, held)
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                self._visit_check_then_act(node, fi, env, path, held)
+                for ch in ast.iter_child_nodes(node):
+                    visit(ch, held)
+                return
+            if isinstance(node, ast.Attribute):
+                self._record_access(node, fi, env, path, held, in_ctor,
+                                    thread_target_refs, call_func_nodes)
+                for ch in ast.iter_child_nodes(node):
+                    visit(ch, held)
+                return
+            for ch in ast.iter_child_nodes(node):
+                visit(ch, held)
+
+        for stmt in fi.node.body:
+            visit(stmt, frozenset())
+
+    def _record_access(self, node: ast.Attribute, fi: _FuncInfo,
+                       env, path, held, in_ctor, thread_target_refs,
+                       call_func_nodes):
+        recv = self._recv_class(node.value, env)
+        if recv is None:
+            return
+        attr = node.attr
+        if attr in recv.methods:
+            # method access: a call edge (handled at the Call) or a
+            # bound-method reference handed off as a callback — the
+            # receiving side calls it on THIS domain's behalf only if
+            # the ref is not a Thread/executor target (those mint their
+            # own domain); either way the ref means unknown callers
+            if isinstance(node.ctx, ast.Load) \
+                    and id(node) not in thread_target_refs \
+                    and id(node) not in call_func_nodes:
+                fi.edges.add(recv.methods[attr].qname)
+                self._escaped.add(recv.methods[attr].qname)
+            return
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        if not is_write and recv.attr_is(attr, _SYNC_CTORS):
+            return  # reading a channel/lock/event to use it IS the channel
+        self.accesses.append(_Access(
+            cls=recv.qname, attr=attr, write=is_write, path=path,
+            line=node.lineno, col=node.col_offset, func=fi.qname,
+            in_ctor=in_ctor, locks=held))
+
+    def _visit_check_then_act(self, node, fi: _FuncInfo, env, path, held):
+        if held:
+            return  # a lock spans the check and the act
+        # attrs read in the test
+        test_reads: Set[Tuple[str, str]] = set()
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.ctx, ast.Load):
+                recv = self._recv_class(sub.value, env)
+                if recv is not None and sub.attr not in recv.methods \
+                        and not recv.attr_is(sub.attr, _SYNC_CTORS):
+                    test_reads.add((recv.qname, sub.attr))
+        if not test_reads:
+            return
+        for stmt in node.body:
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Attribute) \
+                        and isinstance(sub.ctx, (ast.Store, ast.Del)):
+                    recv = self._recv_class(sub.value, env)
+                    if recv is not None \
+                            and (recv.qname, sub.attr) in test_reads:
+                        acc = _Access(
+                            cls=recv.qname, attr=sub.attr, write=True,
+                            path=path, line=node.lineno,
+                            col=node.col_offset, func=fi.qname,
+                            in_ctor=fi.name in _CTOR_FUNCS, locks=held)
+                        self.check_then_act.append((acc, sub.attr))
+
+    # ------------------------------------------------------------ domains
+    def _domains(self) -> Dict[str, Set[str]]:
+        """domain name -> reachable function qnames (closure over call
+        and callback-reference edges)."""
+        roots: Dict[str, Set[str]] = {}
+
+        def add_root(domain: str, qname: str):
+            roots.setdefault(domain, set()).add(qname)
+
+        for sp in self.spawns:
+            add_root(sp.domain, sp.target_qname)
+        for fi in self.funcs.values():
+            for d in fi.declared_domains:
+                add_root(d, fi.qname)
+            if fi.is_async:
+                c = self.collectors.get(fi.module)
+                if c is not None and c.has_asyncio:
+                    add_root(_ASYNCIO, fi.qname)
+            if fi.cls is not None:
+                known = _KNOWN_ROOTS.get((fi.cls, fi.name))
+                if known is not None:
+                    add_root(known, fi.qname)
+        domains: Dict[str, Set[str]] = {}
+        for domain, seeds in roots.items():
+            seen: Set[str] = set()
+            work = deque(seeds)
+            while work:
+                q = work.popleft()
+                if q in seen:
+                    continue
+                seen.add(q)
+                fi = self.funcs.get(q)
+                if fi is None:
+                    continue
+                work.extend(fi.edges - seen)
+            domains[domain] = seen
+        self._roots = {d: sorted(s) for d, s in roots.items()}
+        return domains
+
+    # -------------------------------------------------------------- rules
+    def run(self) -> OwnershipReport:
+        self._lock_edges: Dict[Tuple, Tuple[str, int, int]] = {}
+        for qname, fi in sorted(self.funcs.items()):
+            path = self.path_of_mod.get(fi.module)
+            if path is not None:
+                self._walk_function(fi, path)
+        domains = self._domains()
+        self._propagate_call_context()
+
+        def domains_of(func_qname: str) -> Set[str]:
+            hit = {d for d, fns in domains.items() if func_qname in fns}
+            return hit or {_CALLER}
+
+        # attribute census keyed by (class, attr)
+        by_attr: Dict[Tuple[str, str], List[_Access]] = {}
+        for a in self.accesses:
+            by_attr.setdefault((a.cls, a.attr), []).append(a)
+
+        # fold the propagated calling context into every access: a
+        # helper whose callers ALL hold lock L writes under L (the
+        # ``_locked`` convention), and a helper called only from its
+        # class's __init__ writes pre-publication
+        for a in self.accesses:
+            a.locks = a.locks | self._entry_locks.get(a.func, frozenset())
+            a.in_ctor = a.in_ctor or a.func in self._ctor_only
+        self.check_then_act = [
+            (a, attr) for a, attr in self.check_then_act
+            if not self._entry_locks.get(a.func)
+            and a.func not in self._ctor_only]
+
+        self._check_1501(by_attr, domains_of)
+        self._check_1502()
+        self._check_1503(by_attr, domains_of)
+        self._check_1504(by_attr, domains_of)
+
+        # one report per (rule, path, line)
+        unique: Dict[Tuple[str, str, int], Violation] = {}
+        for v in sorted(self.violations,
+                        key=lambda v: (v.path, v.line, v.rule, v.col)):
+            unique.setdefault((v.rule, v.path, v.line), v)
+        out = sorted(unique.values(),
+                     key=lambda v: (v.path, v.line, v.rule))
+        self._apply_suppressions(out)
+        return OwnershipReport(violations=out,
+                               domains=getattr(self, "_roots", {}),
+                               files_scanned=self.files_scanned)
+
+    def _propagate_call_context(self):
+        """Bounded-fixpoint interprocedural context:
+
+        * ``_entry_locks[f]`` — locks held at EVERY in-package call site
+          of ``f`` (callers' own entry locks included), so the
+          ``_locked``-suffix convention (caller takes ``self._mu``,
+          callee mutates) is protected, not flagged.
+        * ``_ctor_only`` — helpers called exclusively from their own
+          class's ``__init__`` (e.g. a ``_rehydrate``): their writes
+          happen pre-publication, like the constructor's own.
+
+        A function whose reference escapes (callback hand-off, thread
+        target, declared root) has unknown callers and earns neither.
+        """
+        escaped = set(self._escaped)
+        escaped |= {sp.target_qname for sp in self.spawns}
+        for fi in self.funcs.values():
+            if fi.declared_domains or fi.is_async \
+                    or (fi.cls, fi.name) in _KNOWN_ROOTS:
+                escaped.add(fi.qname)
+
+        entry: Dict[str, frozenset] = {}
+        for _ in range(4):  # deepest helper chains here are < 4 calls
+            new: Dict[str, Optional[frozenset]] = {}
+            for fi in self.funcs.values():
+                caller_locks = entry.get(fi.qname, frozenset())
+                for callee_q, held in fi.call_sites:
+                    eff = frozenset(held) | caller_locks
+                    cur = new.get(callee_q)
+                    new[callee_q] = eff if cur is None else (cur & eff)
+            nxt = {q: s for q, s in new.items()
+                   if s and q not in escaped}
+            if nxt == entry:
+                break
+            entry = nxt
+        self._entry_locks = entry
+
+        callers: Dict[str, Set[str]] = {}
+        for fi in self.funcs.values():
+            for callee_q, _held in fi.call_sites:
+                callers.setdefault(callee_q, set()).add(fi.qname)
+        ctor_only: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for q, fi in self.funcs.items():
+                if q in ctor_only or q in escaped \
+                        or fi.name in _CTOR_FUNCS:
+                    continue
+                sites = callers.get(q)
+                if not sites:
+                    continue
+                if all(
+                    (cf := self.funcs.get(c)) is not None
+                    and cf.cls == fi.cls and cf.module == fi.module
+                    and (cf.name in _CTOR_FUNCS or c in ctor_only)
+                    for c in sites
+                ):
+                    ctor_only.add(q)
+                    changed = True
+        self._ctor_only = ctor_only
+
+    def _add(self, rule, acc_or_site, msg: str):
+        if isinstance(acc_or_site, _Access):
+            path, line, col = acc_or_site.path, acc_or_site.line, \
+                acc_or_site.col
+        else:
+            path, line, col = acc_or_site
+        self.violations.append(Violation(
+            rule.id, path, line, col, f"{rule.name}: {msg}"))
+
+    @staticmethod
+    def _short(cls_qname: str) -> str:
+        return cls_qname.split("::", 1)[-1]
+
+    def _check_1501(self, by_attr, domains_of):
+        for (cls, attr), accs in sorted(by_attr.items()):
+            writes = [a for a in accs if a.write and not a.in_ctor]
+            if not writes:
+                continue
+            wdomains: Set[str] = set()
+            for a in writes:
+                wdomains |= domains_of(a.func)
+            if len(wdomains) < 2:
+                continue
+            if _ASYNCIO in wdomains:
+                continue  # event-loop-owned state is TPL1504's turf
+            common = frozenset.intersection(*[a.locks for a in writes]) \
+                if writes else frozenset()
+            if common:
+                continue  # one lock held at every write site
+            names = ", ".join(sorted(wdomains))
+            for a in writes:
+                self._add(R.RULES["TPL1501"], a,
+                          f"{self._short(cls)}.{attr} is written from "
+                          f"thread domains [{names}] with no common lock "
+                          f"and no queue/deque channel between them")
+
+    def _check_1502(self):
+        edges = getattr(self, "_lock_edges", {})
+        graph: Dict[Tuple[str, str], Set[Tuple[str, str]]] = {}
+        # lexical edges
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+        # one-level call-through edges: holding A, calling f that
+        # lexically acquires B
+        for fi in self.funcs.values():
+            for callee_q, held, line, col in fi.locked_calls:
+                callee = self.funcs.get(callee_q)
+                if callee is None:
+                    continue
+                path = self.path_of_mod.get(fi.module)
+                for a in held:
+                    for b in callee.acquires:
+                        if a != b and (a, b) not in edges:
+                            edges[(a, b)] = (path, line, col)
+                            graph.setdefault(a, set()).add(b)
+        # report every edge that sits on a cycle
+        def reaches(src, dst) -> bool:
+            seen, work = set(), deque([src])
+            while work:
+                n = work.popleft()
+                if n == dst:
+                    return True
+                if n in seen:
+                    continue
+                seen.add(n)
+                work.extend(graph.get(n, ()))
+            return False
+
+        for (a, b), site in sorted(edges.items()):
+            if site[0] is None:
+                continue
+            if reaches(b, a):
+                self._add(R.RULES["TPL1502"], site,
+                          f"acquiring {self._short(a[0])}.{a[1]} then "
+                          f"{self._short(b[0])}.{b[1]} inverts another "
+                          f"path's acquisition order (cycle in the "
+                          f"lock-order graph): concurrent entry deadlocks")
+
+    def _check_1503(self, by_attr, domains_of):
+        for acc, attr in self.check_then_act:
+            accs = by_attr.get((acc.cls, attr), [])
+            touch_domains: Set[str] = set()
+            for a in accs:
+                if not a.in_ctor:
+                    touch_domains |= domains_of(a.func)
+            if len(touch_domains) < 2:
+                continue  # single-domain check-then-act is just code
+            names = ", ".join(sorted(touch_domains))
+            self._add(R.RULES["TPL1503"], acc,
+                      f"test reads {self._short(acc.cls)}.{attr} and the "
+                      f"branch writes it back with no lock across both, "
+                      f"while domains [{names}] share the attribute — "
+                      f"another thread can interleave between check and "
+                      f"act")
+
+    def _check_1504(self, by_attr, domains_of):
+        for (cls, attr), accs in sorted(by_attr.items()):
+            loop_writes = [a for a in accs if a.write and not a.in_ctor
+                           and _ASYNCIO in domains_of(a.func)]
+            if not loop_writes:
+                continue
+            for a in accs:
+                if not a.write or a.in_ctor:
+                    continue
+                doms = domains_of(a.func)
+                if _ASYNCIO in doms or doms == {_CALLER}:
+                    continue
+                fi = self.funcs.get(a.func)
+                if fi is not None and fi.calls_soon_threadsafe:
+                    continue
+                names = ", ".join(sorted(doms))
+                self._add(R.RULES["TPL1504"], a,
+                          f"{self._short(cls)}.{attr} is event-loop-owned "
+                          f"(written by async def code) but mutated from "
+                          f"thread domain [{names}] without "
+                          f"call_soon_threadsafe")
+
+    # -------------------------------------------------------- suppression
+    def _apply_suppressions(self, violations: List[Violation]):
+        for v in violations:
+            lines = self.lines.get(v.path)
+            if not lines:
+                continue
+            codes, reason = _suppressions_for_line(lines, v.line)
+            if v.rule in codes or "ALL" in codes:
+                v.suppressed = True
+                v.suppress_reason = reason
+
+
+def _suppressions_for_line(lines: List[str], line_no: int):
+    """Same contract as tpulint: a disable comment on the line itself or
+    in the contiguous pure-comment block directly above."""
+    candidates = []
+    if 1 <= line_no <= len(lines):
+        candidates.append(lines[line_no - 1])
+    ln = line_no - 1
+    while ln >= 1 and lines[ln - 1].lstrip().startswith("#"):
+        candidates.append(lines[ln - 1])
+        ln -= 1
+    for text in candidates:
+        m = _SUPPRESS_RE.search(text)
+        if m:
+            codes = {c.strip().upper() for c in m.group(1).split(",")
+                     if c.strip()}
+            return codes, (m.group("reason") or "").strip()
+    return set(), ""
+
+
+# ----------------------------------------------------------- public API
+
+
+def analyze_sources(sources: Dict[str, str]) -> OwnershipReport:
+    """Cross-module analysis over {path: source}. Violations include
+    suppressed ones (check ``.suppressed``), like ``lint_source``."""
+    return _Analyzer(sources).run()
+
+
+def analyze_file(path: str, source: Optional[str] = None
+                 ) -> List[Violation]:
+    """Single-file mode — what ``lint_source`` embeds, so ``make lint``
+    and the fixture tests see TPL15xx too. Strictly weaker than the
+    package-level sweep (cross-module roots are invisible)."""
+    if source is None:
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+    return analyze_sources({path: source}).violations
+
+
+def analyze_paths(paths: Sequence[str]) -> Tuple[LintResult,
+                                                 OwnershipReport]:
+    """Package-level sweep over files/directories (the ``make races``
+    entry). Returns (LintResult with live/suppressed split, report)."""
+    sources: Dict[str, str] = {}
+    for p in _iter_py_files(paths):
+        with open(p, "r", encoding="utf-8") as f:
+            sources[p] = f.read()
+    report = analyze_sources(sources)
+    result = LintResult(files_scanned=report.files_scanned)
+    for v in report.violations:
+        (result.suppressed if v.suppressed else result.violations).append(v)
+    return result, report
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """tpurace CLI (``tools/race_tpu.py`` shim target).
+
+    Exit codes: 0 clean, 1 live violations (with --fail-on-violation)
+    or suppression cap exceeded, 2 usage error."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="race_tpu",
+        description="tpurace: cross-module thread-ownership & race "
+                    "analysis (TPL1501-TPL1504)")
+    ap.add_argument("paths", nargs="*", default=["paddle_tpu"])
+    ap.add_argument("--fail-on-violation", action="store_true")
+    ap.add_argument("--max-suppressions", type=int, default=None,
+                    help="fail if the tree carries more than N "
+                         "suppressed TPL15xx findings (keeps the "
+                         "escape hatch from becoming a habit)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--show-domains", action="store_true",
+                    help="print the discovered thread domains and roots")
+    ap.add_argument("--show-suppressed", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 0 if e.code in (0, None) else 2
+    paths = args.paths or ["paddle_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"race_tpu: no such path: {', '.join(missing)}")
+        return 2
+    result, report = analyze_paths(paths)
+    if args.format == "json":
+        print(json.dumps({
+            "files_scanned": result.files_scanned,
+            "domains": report.domains,
+            "violations": [vars(v) for v in result.violations],
+            "suppressed": [vars(v) for v in result.suppressed],
+        }, indent=2))
+    else:
+        if args.show_domains:
+            for d in sorted(report.domains):
+                print(f"domain {d}:")
+                for r in report.domains[d]:
+                    print(f"  root {r}")
+        for v in result.violations:
+            print(v.format())
+        if args.show_suppressed:
+            for v in result.suppressed:
+                print(v.format())
+        print(f"tpurace: {result.files_scanned} files, "
+              f"{len(report.domains)} thread domains, "
+              f"{len(result.violations)} violations, "
+              f"{len(result.suppressed)} suppressed")
+    if args.max_suppressions is not None \
+            and len(result.suppressed) > args.max_suppressions:
+        print(f"race_tpu: {len(result.suppressed)} suppressions exceed "
+              f"the cap ({args.max_suppressions}); fix findings instead "
+              f"of disabling them")
+        return 1
+    if args.fail_on_violation and result.violations:
+        return 1
+    return 0

@@ -54,6 +54,18 @@ Families (first digit of the numeric part):
   every dispatch behind a PCIe-sized copy; the async capture-dispatch
   + background-worker split exists so demotion never costs the engine
   thread more than a gather dispatch.
+* ``15xx`` — thread ownership (ISSUE 19, **tpurace** —
+  ``analysis/ownership.py``): the serving stack's concurrency
+  discipline ("one engine thread; the worker communicates exclusively
+  through the job queue and the completion deque") as a machine-checked
+  invariant. The analyzer discovers thread entrypoints
+  (``threading.Thread(target=...)``, ``run_in_executor``, ``async
+  def`` handlers, ``@thread_domain``-declared roots), computes each
+  domain's reachable call graph, and checks the per-class attribute
+  read/write sets each domain touches: unsanctioned cross-domain
+  writes, lock-order cycles, unlocked check-then-act, and
+  event-loop-owned state mutated from plain threads. The runtime twin
+  is ``analysis.runtime.ownership_guard``.
 """
 from __future__ import annotations
 
@@ -304,6 +316,50 @@ TRACING_IN_TRACE = _rule(
     "Tracing is HOST telemetry (ISSUE 18) — record between dispatches "
     "in the scheduler, or return the value out of the compiled region "
     "and record at harvest. The metrics sibling is TPL601.")
+
+
+CROSS_THREAD_WRITE = _rule(
+    "TPL1501", "thread-ownership", "cross-thread-write-without-channel",
+    "the same instance attribute is written from two or more thread "
+    "domains with no sanctioned channel between them: no queue.Queue "
+    "put/get hand-off, no GIL-atomic deque append/popleft, and no "
+    "single threading.Lock/RLock/Condition held at EVERY write site. "
+    "Interleaved writes tear the state (lost updates, a reader in a "
+    "third domain sees half of each) and the failure is timing-"
+    "dependent — it survives every single-threaded test. Route the "
+    "hand-off through a channel the way kv_tier's worker does (job "
+    "queue in, completion deque out), or guard every write with one "
+    "common lock. Runtime twin: analysis.runtime.ownership_guard.")
+
+LOCK_ORDER_INVERSION = _rule(
+    "TPL1502", "thread-ownership", "lock-order-inversion",
+    "the lock-acquisition-order graph has a cycle: some code path "
+    "acquires lock A then lock B while another acquires B then A. Two "
+    "threads entering the inverted paths concurrently deadlock — each "
+    "holds the lock the other needs, forever, with no exception and no "
+    "timeout. Impose one global acquisition order (acquire the outer "
+    "lock first everywhere), or collapse the pair into a single lock.")
+
+CHECK_THEN_ACT = _rule(
+    "TPL1503", "thread-ownership", "unsynchronized-check-then-act",
+    "an if/while test reads a shared attribute (one that other thread "
+    "domains also touch) and its body writes the SAME attribute, with "
+    "no lock held across the test and the write. Another domain can "
+    "interleave between check and act — two threads both pass `if not "
+    "self._started:` and both start — the classic test-then-set race. "
+    "Hold one lock across both halves, or make the transition a single "
+    "atomic operation on a channel/Event.")
+
+EVENT_LOOP_STATE_FROM_THREAD = _rule(
+    "TPL1504", "thread-ownership", "event-loop-state-from-thread",
+    "state owned by the asyncio event loop (an attribute written by "
+    "`async def` code) is mutated from a plain thread without going "
+    "through loop.call_soon_threadsafe. asyncio's single-threaded "
+    "contract means loop-side readers run unlocked — a thread-side "
+    "write races every coroutine touching the attribute, and asyncio "
+    "primitives (Event/Queue/Future) are NOT thread-safe from outside "
+    "the loop. Trampoline the mutation with call_soon_threadsafe, the "
+    "way the SSE bridge forwards engine-thread chunks.")
 
 
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -1,22 +1,47 @@
-"""Runtime companion to tpulint: tracer-leak guard for the compiled path.
+"""Runtime companions to the static passes: tracer-leak guard and
+thread-ownership guard.
 
-Static analysis catches what it can see; ``leak_guard`` catches the rest at
-runtime by arming ``jax.check_tracer_leaks`` around a compiled-path entry.
-A leaked tracer (a traced value stashed into module/closure state — the
-runtime shadow of TPL401/TPL402) then raises at trace end instead of
-detonating later as an inscrutable ``UnexpectedTracerError`` far from the
-leak site.
+Static analysis catches what it can see; these catch the rest at runtime.
+``leak_guard`` arms ``jax.check_tracer_leaks`` around a compiled-path
+entry so a leaked tracer (the runtime shadow of TPL401/TPL402) raises at
+trace end instead of detonating later as an inscrutable
+``UnexpectedTracerError`` far from the leak site.
 
-Opt-in, because leak checking disables some tracing fast paths: set
-``PADDLE_TPU_CHECK_TRACERS=1`` in the environment (or
-``paddle.set_flags({"FLAGS_check_tracers": True})``) — CI and tests do; the
-production hot path keeps it off.
+``ownership_guard`` (ISSUE 19) is the dynamic twin of tpurace
+(``analysis/ownership.py``, TPL1501–TPL1504): with the guard armed,
+:func:`guard_object`-wrapped objects (Engine, CacheCoordinator,
+PrefixCache, HostTier — :func:`guard_engine` wires all four) stamp the
+owning thread on the FIRST attribute write after arming and raise a typed
+:class:`OwnershipError` on any later write from a different thread.
+Sanctioned channels stay invisible by construction: ``queue.Queue``
+put/get, ``deque`` append/popleft and ``Event`` set/wait are METHOD
+calls, not attribute writes, so the deque-out/queue-in contract the
+static pass trusts is exactly the surface the runtime guard never
+touches. Conversely, writes the static pass cannot see —
+``setattr(obj, name, v)``, reflection, aliases through untyped
+containers — hit ``__setattr__`` like any other write and are caught
+(the ``racey-worker-write`` fault point proves this in chaos).
+
+Honest limits: write-side only (a torn READ of a half-updated structure
+is invisible — intercepting ``__getattribute__`` would blow the <2%
+``ownership_guard_overhead_frac`` budget), per-attribute (two attrs of
+one object may legitimately have different owners), and ownership is
+re-stamped at each arming, so construct-then-publish hand-offs are fine
+as long as publication precedes arming.
+
+Both guards are opt-in, because checking costs fast paths: set
+``PADDLE_TPU_CHECK_TRACERS=1`` / ``PADDLE_TPU_CHECK_OWNERSHIP=1`` in the
+environment (or the ``FLAGS_check_tracers`` / ``FLAGS_check_ownership``
+flags) — CI and tests do; the production hot path keeps them off.
 """
 from __future__ import annotations
 
 import contextlib
+import threading
 
-__all__ = ["leak_guard", "tracer_checks_enabled", "TracerLeakError"]
+__all__ = ["leak_guard", "tracer_checks_enabled", "TracerLeakError",
+           "ownership_guard", "ownership_checks_enabled", "OwnershipError",
+           "guard_object", "guard_engine", "thread_domain"]
 
 
 class TracerLeakError(RuntimeError):
@@ -57,3 +82,143 @@ def leak_guard(enabled: bool = None):
                     "see tpulint rules TPL401/TPL402. Original error: "
                     f"{e}") from e
             raise
+
+
+# --------------------------------------------------- thread-ownership guard
+
+
+class OwnershipError(RuntimeError):
+    """A guarded object's attribute was written from a thread that does
+    not own it (see tpurace, rules TPL1501-TPL1504). Route the write
+    through the object's sanctioned channel (job queue / completion
+    deque / ``call_soon_threadsafe``) instead."""
+
+
+def ownership_checks_enabled() -> bool:
+    from ..framework import flags
+
+    return bool(flags.get_flags(
+        "FLAGS_check_ownership")["FLAGS_check_ownership"])
+
+
+def thread_domain(name: str):
+    """Declare the decorated function as the root of thread domain
+    ``name`` for tpurace discovery — the escape hatch for entrypoints
+    the structural discovery cannot see (callbacks registered with C
+    extensions, signal handlers). Runtime no-op beyond tagging."""
+    def deco(fn):
+        tags = getattr(fn, "__tpu_thread_domains__", ())
+        fn.__tpu_thread_domains__ = tags + (name,)
+        return fn
+    return deco
+
+
+# armed > 0 while any ownership_guard() is active; gen bumps at each
+# arming so owner stamps never survive one guarded region into the next
+# (the engine thread of run A is not the engine thread of run B)
+_OWNERSHIP = {"armed": 0, "gen": 0}
+
+
+class _GuardRec:
+    __slots__ = ("label", "exempt", "owners", "gen", "lock")
+
+    def __init__(self, label, exempt):
+        self.label = label
+        self.exempt = frozenset(exempt)
+        self.owners = {}          # attr -> owning Thread (this arming)
+        self.gen = -1
+        self.lock = threading.Lock()
+
+
+_GUARDED_SUBCLASS = {}            # base class -> guarded subclass
+
+
+def guard_object(obj, label: str = None, exempt=()):
+    """Wrap ``obj`` so that, while :func:`ownership_guard` is armed,
+    the first thread to write each attribute owns it and any other
+    thread's write raises :class:`OwnershipError`. Write-side only and
+    idempotent; ``exempt`` names attributes deliberately multi-writer
+    under their own lock. Returns ``obj`` (the wrap swaps
+    ``__class__`` to a dynamic subclass, so identity and isinstance
+    are preserved)."""
+    base = type(obj)
+    if getattr(base, "_tpu_ownership_guarded", False):
+        return obj
+    sub = _GUARDED_SUBCLASS.get(base)
+    if sub is None:
+        def __setattr__(self, attr, value, _base=base):
+            rec = self.__dict__.get("_tpu_guard_rec")
+            if (rec is not None and _OWNERSHIP["armed"]
+                    and not attr.startswith("__")
+                    and attr != "_tpu_guard_rec"
+                    and attr not in rec.exempt):
+                me = threading.current_thread()
+                with rec.lock:
+                    if rec.gen != _OWNERSHIP["gen"]:
+                        rec.owners.clear()
+                        rec.gen = _OWNERSHIP["gen"]
+                    owner = rec.owners.setdefault(attr, me)
+                if owner is not me:
+                    raise OwnershipError(
+                        f"{rec.label}.{attr} is owned by thread "
+                        f"{owner.name!r} (first writer under the armed "
+                        f"guard) but was written from {me.name!r}: "
+                        f"cross-thread write outside the sanctioned "
+                        f"channels. Hand the value through the job "
+                        f"queue / completion deque, hold the owning "
+                        f"lock, or marshal via call_soon_threadsafe "
+                        f"(tpurace TPL1501).")
+            _base.__setattr__(self, attr, value)
+
+        sub = type(f"{base.__name__}(ownership-guarded)", (base,), {
+            "__setattr__": __setattr__,
+            "_tpu_ownership_guarded": True,
+            # dynamic subclass: keep pickling/repr pointing at the base
+            "__module__": base.__module__,
+        })
+        _GUARDED_SUBCLASS[base] = sub
+    object.__setattr__(obj, "_tpu_guard_rec",
+                       _GuardRec(label or base.__name__, exempt))
+    obj.__class__ = sub
+    return obj
+
+
+def guard_engine(engine):
+    """Guard the serving stack's shared-ownership objects: the Engine
+    itself plus its CacheCoordinator, PrefixCache, and HostTier (the
+    objects the kv-tier channel contract protects). getattr-based so a
+    tierless or cacheless engine guards whatever it actually has."""
+    guard_object(engine, label="Engine")
+    cache = getattr(engine, "_cache", None)
+    if cache is not None:
+        guard_object(cache, label="CacheCoordinator")
+        pcache = getattr(cache, "pcache", None)
+        if pcache is not None:
+            guard_object(pcache, label="PrefixCache")
+        tier = getattr(cache, "tier", None)
+        if tier is not None:
+            guard_object(tier, label="HostTier")
+    return engine
+
+
+@contextlib.contextmanager
+def ownership_guard(enabled: bool = None):
+    """Arm cross-thread write detection on every guarded object for the
+    duration of the block. ``enabled=None`` defers to
+    ``FLAGS_check_ownership`` / ``PADDLE_TPU_CHECK_OWNERSHIP``, so
+    callers can wrap entry points unconditionally and pay nothing
+    (one dict lookup per guarded write) unless the check is armed.
+    Arm AFTER construction/hand-off: ownership stamps begin at the
+    first write inside the armed region, so the constructor thread is
+    never mistaken for the owner."""
+    if enabled is None:
+        enabled = ownership_checks_enabled()
+    if not enabled:
+        yield
+        return
+    _OWNERSHIP["gen"] += 1
+    _OWNERSHIP["armed"] += 1
+    try:
+        yield
+    finally:
+        _OWNERSHIP["armed"] -= 1

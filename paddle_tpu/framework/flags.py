@@ -90,6 +90,19 @@ define_flag("FLAGS_fault_inject",
             "Empty (the default) disables injection; also settable via "
             "env PADDLE_TPU_FAULT_INJECT. Engine(fault_plan=...) "
             "overrides per instance")
+define_flag("FLAGS_check_ownership",
+            os.environ.get("PADDLE_TPU_CHECK_OWNERSHIP", "").lower()
+            in ("1", "true", "yes"),
+            "arm the runtime thread-ownership guard "
+            "(paddle_tpu.analysis.ownership_guard; ISSUE 19): guarded "
+            "objects (Engine/CacheCoordinator/PrefixCache/HostTier via "
+            "guard_engine) stamp the first writing thread per attribute "
+            "and raise OwnershipError on a write from any other thread "
+            "— the dynamic twin of the tpurace TPL1501-TPL1504 static "
+            "pass. Also settable via env PADDLE_TPU_CHECK_OWNERSHIP=1. "
+            "Off by default: adds a dict lookup to every guarded "
+            "attribute write (<2%% end-to-end, gated by "
+            "bench_ownership)")
 define_flag("FLAGS_check_tracers",
             os.environ.get("PADDLE_TPU_CHECK_TRACERS", "").lower()
             in ("1", "true", "yes"),

@@ -1840,6 +1840,13 @@ class Engine:
             admits.append((req, slot, prefix, base))
         if not admits:
             return [], None, None, None
+        # register the wave for step-fault recovery BEFORE the prefill
+        # dispatch: these requests were popped from _queue but are not in
+        # _active until the commit below, so a trace/dispatch error here
+        # used to lose them from the engine entirely — the request never
+        # reached a terminal state and its stream (and any router ticket
+        # waiting on it) hung forever instead of failing attributably
+        self._pending_inflight = admits
         tok, new_keys, bad = self._prefill_wave(
             [(req, prefix, self.tables[slot], base)
              for req, slot, prefix, base in admits])
@@ -1856,6 +1863,7 @@ class Engine:
             if req._key is not None:
                 self._keys[slot] = req._key
             self._note_admitted(req)
+        self._pending_inflight = []
         return admits, tok, new_keys, bad
 
     def _note_admitted(self, req):
@@ -2293,6 +2301,12 @@ class Engine:
             pending.append((req, row, prefix, base))
         if not pending:
             return [], None, None, None
+        # same step-fault-recovery registration as the admission wave:
+        # pre-admitted requests are in neither _queue nor _active until
+        # _activate_pending commits, and the caller's own registration
+        # happens only AFTER this dispatch returns — a trace/dispatch
+        # fault inside the wave used to black-hole the whole batch
+        self._pending_inflight = pending
         tok, new_keys, bad = self._prefill_wave(
             [(req, prefix, row, base) for req, row, prefix, base in pending])
         return pending, tok, new_keys, bad
@@ -2836,12 +2850,15 @@ class Engine:
             req._key = self._keys[slot].copy()
             req.slot = None
             self._requeue(req)
-        # pre-admitted requests whose prefill was in flight live only in
-        # the failed step's locals — without this they would vanish from
-        # the engine entirely (their standalone page rows die with the
-        # pool reset below, which is fine: recompute policy)
+        # admission-wave/pre-admitted requests whose prefill was in
+        # flight live only in the failed step's locals — without this
+        # they would vanish from the engine entirely (their standalone
+        # page rows die with the pool reset below, which is fine:
+        # recompute policy). The _queue check covers a fault landing
+        # AFTER the wave committed to _active: the loop above already
+        # requeued those, and a double insert would duplicate the stream
         for req, *_ in self._pending_inflight:
-            if not req.done:
+            if not req.done and req not in self._queue:
                 self._requeue(req)
         self._pending_inflight = []
         # router-stats handles of the failed step's dispatches are dead

@@ -433,12 +433,34 @@ class HostTier:
         """Route a worker-side failure into containment: the completion
         drives :meth:`_contain_bad` on the engine thread (invalidate +
         recompute-as-miss + drop accounting) — a faulted copy doubts
-        the block, it never silently parks it."""
-        self._done.append(("fault", job[1], job[2], job[3]))
+        the block, it never silently parks it. A spill job carries a
+        WAVE of (ent, token, ...) items where a promote job carries one
+        entry inline — post one fault per entry, or the drain's
+        ``ent.job == token`` check would choke on the raw item list
+        (found by the ISSUE 19 ``_done``-drain audit: the old
+        single-message form was promote-shaped only)."""
+        if job[0] == "spill":
+            for ent, token, _hslot, _dev_sum in job[2]:
+                self._done.append(("fault", job[1], ent, token))
+        else:
+            self._done.append(("fault", job[1], job[2], job[3]))
 
     def _worker_job(self, job):
         import jax
 
+        fi = self.engine._fi
+        if fi is not None and fi.fire("racey-worker-write"):
+            # deliberate ownership violation (ISSUE 19 satellite): poke
+            # an engine-owned counter from the worker, bypassing the
+            # job-queue/completion-deque channel. setattr keeps the
+            # write invisible to the static tpurace pass (reflection is
+            # a documented blind spot) — proving the RUNTIME guard
+            # covers what the linter cannot: with ownership_guard()
+            # armed this raises OwnershipError, the worker isolation
+            # above routes it through _post_fault, and the engine drain
+            # contains the job as a counted drop (chaos-asserted).
+            # Guard off: value-identical no-op.
+            setattr(self, "demotions", self.demotions + 0)
         kind = job[0]
         if kind == "spill":
             _, gen, items, handles = job

@@ -27,6 +27,16 @@ gauge (0/1/2), so dashboards can alert on "engine survived but is
 running degraded" — the state the whole layer exists to make reachable.
 All of this is host-side scheduler code; nothing here is ever traced.
 
+Thread contract (audited for tpurace, ISSUE 19): every state-mutating
+method (``note_*``, ``quarantine``, ``_degrade``/``_recover``/
+``_apply``) runs on the engine thread — the step loop and the
+integrity sentinel both live there. The only cross-thread surface is
+read-only: ``ready``/``readiness()`` polled by the asyncio server and
+the router supervisor, over GIL-atomic ints/bools, with
+``quarantined`` a monotone latch (False→True once, never back), so a
+torn read is impossible and a momentarily stale one only delays the
+routing reaction by a poll interval.
+
 **Quarantine (ISSUE 14)** is an orthogonal, STICKY axis on top of the
 levels: when the integrity sentinel proves the engine's own state is
 corrupt (a weight-audit digest mismatch — the weights every future

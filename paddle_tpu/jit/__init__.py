@@ -15,6 +15,7 @@ InterpreterCore/CINN to escape.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
@@ -104,6 +105,19 @@ def _named_state_tensors(layer) -> Dict[str, Tensor]:
 
 import contextlib
 
+# Functional binding works by MUTATING the module tree (Tensor._data is
+# swapped for the traced region and restored after). Two threads tracing
+# through the SAME layer object — e.g. two in-process serving replicas
+# sharing one model — interleave those writes: thread B saves thread A's
+# in-flight tracers as the "originals" and faithfully restores them, so
+# the layer is left holding tracers from a completed trace and every
+# later forward dies with UnexpectedTracerError (tpurace TPL1501: a
+# cross-thread write with no sanctioned channel). One process-wide
+# reentrant lock serializes the swap→forward→restore window; it is held
+# only at trace time (jit replays never re-enter the Python body), so
+# steady-state dispatch cost is zero.
+_SWAP_LOCK = threading.RLock()
+
 
 @contextlib.contextmanager
 def swapped_tensors(tensors, arrays):
@@ -114,14 +128,15 @@ def swapped_tensors(tensors, arrays):
     buffers (``WeightOnlyLinear`` registers int8/int4 weights as buffers,
     and baking 100s of MB of them into the program as constants would
     bloat every compile)."""
-    saved = [t._data for t in tensors]
-    try:
-        for t, a in zip(tensors, arrays):
-            t._data = a
-        yield
-    finally:
-        for t, d in zip(tensors, saved):
-            t._data = d
+    with _SWAP_LOCK:
+        saved = [t._data for t in tensors]
+        try:
+            for t, a in zip(tensors, arrays):
+                t._data = a
+            yield
+        finally:
+            for t, d in zip(tensors, saved):
+                t._data = d
 
 
 @contextlib.contextmanager
@@ -131,15 +146,16 @@ def swapped_params(layer, arrays):
     multi-call sibling of :func:`functional_call` (which swaps around ONE
     forward). Used by whole-program traces (generation scan, pipeline
     engine) that invoke the layer repeatedly inside one trace."""
-    named = list(layer.named_parameters())
-    saved = [p._data for _, p in named]
-    try:
-        for (_, p), a in zip(named, arrays):
-            p._data = a
-        yield
-    finally:
-        for (_, p), d in zip(named, saved):
-            p._data = d
+    with _SWAP_LOCK:
+        named = list(layer.named_parameters())
+        saved = [p._data for _, p in named]
+        try:
+            for (_, p), a in zip(named, arrays):
+                p._data = a
+            yield
+        finally:
+            for (_, p), d in zip(named, saved):
+                p._data = d
 
 
 def functional_call(
@@ -161,35 +177,37 @@ def functional_call(
     (e.g. BatchNorm running stats updated during a training forward) as a
     dict, for threading through a scan/jit step.
     """
-    named = _named_state_tensors(layer)
-    saved: Dict[str, Any] = {}
-    try:
-        for name, arr in state.items():
-            t = named.get(name)
-            if t is None:
-                raise KeyError(
-                    f"functional_call: state key {name!r} not found in layer"
-                )
-            saved[name] = t._data
-            t._data = arr if not isinstance(arr, Tensor) else arr._data
-        with pause_tape():
-            out = layer(*args, **kwargs)
-        out = jax.tree_util.tree_map(
-            lambda x: x._data if isinstance(x, Tensor) else x,
-            out,
-            is_leaf=lambda x: isinstance(x, Tensor),
-        )
-        if return_buffers:
-            new_buffers = {
-                name: b._data
-                for name, b in layer.named_buffers()
-                if b is not None and name in state
-            }
-            return out, new_buffers
-        return out
-    finally:
-        for name, arr in saved.items():
-            named[name]._data = arr
+    with _SWAP_LOCK:
+        named = _named_state_tensors(layer)
+        saved: Dict[str, Any] = {}
+        try:
+            for name, arr in state.items():
+                t = named.get(name)
+                if t is None:
+                    raise KeyError(
+                        f"functional_call: state key {name!r} not found in "
+                        "layer"
+                    )
+                saved[name] = t._data
+                t._data = arr if not isinstance(arr, Tensor) else arr._data
+            with pause_tape():
+                out = layer(*args, **kwargs)
+            out = jax.tree_util.tree_map(
+                lambda x: x._data if isinstance(x, Tensor) else x,
+                out,
+                is_leaf=lambda x: isinstance(x, Tensor),
+            )
+            if return_buffers:
+                new_buffers = {
+                    name: b._data
+                    for name, b in layer.named_buffers()
+                    if b is not None and name in state
+                }
+                return out, new_buffers
+            return out
+        finally:
+            for name, arr in saved.items():
+                named[name]._data = arr
 
 
 # ------------------------------------------------------------------ to_static

@@ -29,6 +29,7 @@ bucketed, so p99s are sharp at bench sample sizes.
 """
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Dict, List, Optional
 
@@ -412,6 +413,91 @@ def bench_trace_serving(cfg, on_tpu: bool) -> Dict:
         "trace_jitter_floor_ms": 1e3 * floor_s,
         "trace_bench_spans": spans,
         "trace_ok": bool(ok),
+    }
+
+
+# ------------------------------------------------------------ ownership
+def bench_ownership_serving(cfg, on_tpu: bool) -> Dict:
+    """bench.py ``bench_ownership`` block (ISSUE 19 satellite): the
+    runtime ownership guard's steady-state cost as an interleaved-rep
+    ratio of median scheduling-step times, guard ARMED vs disarmed, on
+    a guarded TIERED engine (Engine + CacheCoordinator + PrefixCache +
+    HostTier all ``guard_engine``-wrapped, so every hot-path attribute
+    write — slot state, counters, tier bookkeeping — pays the
+    ``__setattr__`` interception). Same harness as ``bench_trace``:
+    per-mode medians floored at the host jitter floor (50 ms CPU smoke
+    host / 20 ms TPU) before the ratio; the gate is
+    ``ownership_guard_overhead_frac`` < 2%. An OwnershipError anywhere
+    in the run would propagate out of the block (the wrapper surfaces
+    it as a bench error), so a finishing run doubles as the clean-tree
+    runtime proof at bench geometry."""
+    from ..analysis import guard_engine, ownership_guard
+    from ..inference.engine import Engine
+    from ..models.gpt import GPTForCausalLM
+
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    vocab = cfg.vocab_size
+    slots = 4
+    eng = Engine(model, max_slots=slots,
+                 num_pages=(slots + 2) * cfg.max_position // 16 + 1,
+                 page_size=16, chunk_size=8 if on_tpu else 2,
+                 max_chain=2, multi_step=4,
+                 prefix_cache=True, kv_host_pages=64)
+    guard_engine(eng)
+    rng = np.random.default_rng(29)
+    # templated prompts: repeats hit the prefix cache and churn the
+    # spill tier, so the guarded HostTier/worker hand-off is ON the
+    # measured path, not idle
+    tpls = [rng.integers(0, vocab, (24,)) for _ in range(3)]
+
+    def workload():
+        return [eng.add_request(
+            np.concatenate([tpls[i % 3],
+                            rng.integers(0, vocab, (5,))]), 8)
+                for i in range(slots)]
+
+    def run_mode(armed, record=None):
+        with ownership_guard(enabled=True) if armed else \
+                contextlib.nullcontext():
+            workload()
+            while True:
+                t0 = time.perf_counter()
+                live = eng.step()
+                if record is not None:
+                    record.append(time.perf_counter() - t0)
+                if not live:
+                    return
+
+    try:
+        # warmup under BOTH modes: compile every program, touch the
+        # armed branch of every guarded __setattr__ once
+        run_mode(False)
+        run_mode(True)
+        # INTERLEAVED (off, on) rep pairs, as in bench_trace: paired
+        # samples share the smoke host's transient load
+        reps, steps = 4, {"off": [], "on": []}
+        for _ in range(reps):
+            run_mode(False, steps["off"])
+            run_mode(True, steps["on"])
+    finally:
+        eng._cache.shutdown_tier()
+    floor_s = 0.020 if on_tpu else 0.050
+    med_off = float(np.median(steps["off"]))
+    med_on = float(np.median(steps["on"]))
+    ratio = max(med_on, floor_s) / max(med_off, floor_s)
+    overhead = max(0.0, ratio - 1.0)
+    ok = overhead < 0.02
+    if not ok:
+        print(f"WARNING: bench_ownership gate failed: overhead="
+              f"{overhead:.4f} (<0.02 required)")
+    return {
+        "ownership_guard_overhead_frac": round(overhead, 4),
+        "ownership_step_ms_off": round(1e3 * med_off, 3),
+        "ownership_step_ms_on": round(1e3 * med_on, 3),
+        "ownership_jitter_floor_ms": 1e3 * floor_s,
+        "ownership_ok": bool(ok),
     }
 
 

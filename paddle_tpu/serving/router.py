@@ -146,11 +146,16 @@ class RouterTicket:
             self._srcs = []
             self._primary = None
             self._cond.notify_all()
-        if self._root is not None:
-            self._root.end(tokens=len(self.tokens),
-                           migrations=self.migrations,
-                           failure=failure_reason)
-            self._root = None
+            # claim the trace root while still holding the lock (the
+            # done-gate above already serializes finishers, but the
+            # submit-side write holds _cond too, so ALL _root writes
+            # share one lock — tpurace TPL1501/TPL1503); end() runs
+            # outside: it may flush an exporter
+            root, self._root = self._root, None
+        if root is not None:
+            root.end(tokens=len(self.tokens),
+                     migrations=self.migrations,
+                     failure=failure_reason)
         if self._on_chunk is not None:
             self._on_chunk(None)
 
@@ -337,11 +342,14 @@ class Router:
         # of a migrated one — renders as one contiguous trace
         spec.t_origin = ticket.t_submit
         if _TRACER.enabled:
-            ticket._root = _TRACER.start(
-                "request", "router", tenant=tenant or "default",
-                prompt_len=len(spec.prompt),
-                max_new_tokens=int(max_new_tokens))
-            spec.trace = ticket._root.ctx.encode()
+            # under the ticket's condition like every later _root touch
+            # (tpurace TPL1501: the monitor thread finishes tickets)
+            with ticket._cond:
+                ticket._root = _TRACER.start(
+                    "request", "router", tenant=tenant or "default",
+                    prompt_len=len(spec.prompt),
+                    max_new_tokens=int(max_new_tokens))
+                spec.trace = ticket._root.ctx.encode()
         with self._lock:
             self._tickets.add(ticket)
         self._place(ticket, resume=None, exclude=())
@@ -397,8 +405,10 @@ class Router:
             ticket.replica = rep.name
             # fresh stall budget for the new home (a migration storm
             # must not count the dead replica's silence against the
-            # live one)
-            ticket.last_progress = time.perf_counter()
+            # live one); under _cond like the delivery-side write
+            # (tpurace TPL1501)
+            with ticket._cond:
+                ticket.last_progress = time.perf_counter()
             try:
                 rep.launch(stream)
             except Exception as e:

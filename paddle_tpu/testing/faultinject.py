@@ -99,6 +99,15 @@ pages device→host and back):
   each spill-worker job, stretching the demote/promote window: lookups
   that land inside it must degrade to misses (partial-prefill
   recompute), never stall the engine thread or deadlock the tier.
+* ``racey-worker-write`` — the spill worker writes an engine-owned
+  ``HostTier`` counter directly (via ``setattr``, so the static
+  tpurace pass cannot see it — ISSUE 19), bypassing the job-queue/
+  completion-deque channel. With ``ownership_guard()`` armed the write
+  raises ``OwnershipError`` inside the worker's isolation, routes
+  through ``_post_fault``, and the engine drain contains the job as a
+  counted drop; guard off, the write is a value-identical no-op — the
+  differential is the chaos suite's proof the runtime guard catches
+  what the linter cannot.
 
 Spec grammar (``FLAGS_fault_inject`` / env ``PADDLE_TPU_FAULT_INJECT`` /
 ``Engine(fault_plan=...)``)::
@@ -161,6 +170,9 @@ POINTS = (
     # worker thread, so chaos replays stay deterministic)
     "kv-spill-corrupt",
     "slow-host-copy",
+    # thread-ownership point (ISSUE 19 — consulted on the spill worker
+    # thread; pairs with analysis.runtime.ownership_guard)
+    "racey-worker-write",
 )
 
 

@@ -1,0 +1,225 @@
+"""Seeded fixtures for the tpurace thread-ownership family (ISSUE 19):
+one bad + one clean twin per rule, TPL1501-TPL1504, plus one
+justified-suppression demo. Per-file analysis is enough here — every
+thread root is spawned in this module.
+
+NOT meant to run; the threads are never started.
+"""
+import asyncio
+import threading
+from collections import deque
+from queue import Queue
+
+
+# --------------------------------------------------------------- TPL1501
+
+class BadCrossWrite:
+    """Seeded-bad: a worker and the caller both bump a plain counter —
+    no queue, no deque, no common lock. TPL1501 fires at EVERY
+    unsanctioned write site."""
+
+    def __init__(self):
+        self.counter = 0
+        self._worker = threading.Thread(target=self._loop,
+                                        name="bad-counter-worker")
+
+    def _loop(self):
+        self.counter += 1  # EXPECT: TPL1501
+
+    def bump(self):
+        self.counter += 1  # EXPECT: TPL1501
+
+
+class CleanChannelTwin:
+    """Clean twin: the worker talks back through a deque (GIL-atomic
+    append/popleft — a sanctioned channel); only the caller writes the
+    counter attribute."""
+
+    def __init__(self):
+        self.counter = 0
+        self._q = Queue()
+        self._done = deque()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="clean-counter-worker")
+
+    def _loop(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            self._done.append(item + 1)
+
+    def bump(self):
+        while self._done:
+            self.counter += self._done.popleft()
+
+
+class CleanLockedTwin:
+    """Clean twin #2: both domains write, but every write site holds
+    the same lock."""
+
+    def __init__(self):
+        self.total = 0
+        self._lock = threading.Lock()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="locked-counter-worker")
+
+    def _loop(self):
+        with self._lock:
+            self.total += 1
+
+    def add(self):
+        with self._lock:
+            self.total += 1
+
+
+# --------------------------------------------------------------- TPL1502
+
+class BadLockOrder:
+    """Seeded-bad: the worker nests a under b, the caller nests b under
+    a — a cycle in the lock-order graph; concurrent entry deadlocks."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="lock-order-worker")
+
+    def _loop(self):
+        with self._a:
+            with self._b:  # EXPECT: TPL1502
+                pass
+
+    def poke(self):
+        with self._b:
+            with self._a:  # EXPECT: TPL1502
+                pass
+
+
+class CleanLockOrderTwin:
+    """Clean twin: both paths acquire in the same global order."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._worker = threading.Thread(target=self._loop,
+                                        name="clean-order-worker")
+
+    def _loop(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def poke(self):
+        with self._a:
+            with self._b:
+                pass
+
+
+# --------------------------------------------------------------- TPL1503
+
+class BadCheckThenAct:
+    """Seeded-bad: the caller tests a budget the worker also reads, then
+    writes it back — nothing holds a lock across check and act, so the
+    worker can interleave between them."""
+
+    def __init__(self):
+        self.budget = 4
+        self._q = Queue()
+        self._worker = threading.Thread(target=self._drain,
+                                        name="cta-worker")
+
+    def _drain(self):
+        if self.budget > 0:
+            self._q.put(self.budget)
+
+    def spend(self):
+        if self.budget > 0:  # EXPECT: TPL1503
+            self.budget -= 1
+
+
+class CleanCheckThenActTwin:
+    """Clean twin: one lock spans both the check and the act (and every
+    other access), so the test's premise cannot go stale."""
+
+    def __init__(self):
+        self.budget = 4
+        self._lock = threading.Lock()
+        self._q = Queue()
+        self._worker = threading.Thread(target=self._drain,
+                                        name="clean-cta-worker")
+
+    def _drain(self):
+        with self._lock:
+            if self.budget > 0:
+                self._q.put(self.budget)
+
+    def spend(self):
+        with self._lock:
+            if self.budget > 0:
+                self.budget -= 1
+
+
+# --------------------------------------------------------------- TPL1504
+
+class BadLoopState:
+    """Seeded-bad: ``status`` is event-loop-owned (an async handler
+    writes it between awaits, assuming single-threaded mutation) but a
+    plain thread mutates it directly."""
+
+    def __init__(self):
+        self.status = "idle"
+        self._worker = threading.Thread(target=self._run,
+                                        name="loop-state-worker")
+
+    async def handle(self):
+        self.status = "serving"
+
+    def _run(self):
+        self.status = "done"  # EXPECT: TPL1504
+
+
+class CleanLoopStateTwin:
+    """Clean twin: the thread marshals the write onto the loop with
+    ``call_soon_threadsafe`` — the callback runs in the asyncio domain,
+    so the loop's single-threaded assumption holds."""
+
+    def __init__(self):
+        self.status = "idle"
+        self.loop = None
+        self._worker = threading.Thread(target=self._run,
+                                        name="clean-loop-worker")
+
+    async def handle(self):
+        self.loop = asyncio.get_running_loop()
+        self.status = "serving"
+
+    def _set_status(self, value):
+        self.status = value
+
+    def _run(self):
+        self.loop.call_soon_threadsafe(self._set_status, "done")
+
+
+# ------------------------------------------------- justified suppression
+
+class SuppressedLatch:
+    """Suppression demo: a deliberate benign race — a monotone bool
+    latch where every writer stores the same value and readers tolerate
+    staleness. Real code earns the disable with exactly this kind of
+    one-line justification."""
+
+    def __init__(self):
+        self.stop = False
+        self._worker = threading.Thread(target=self._spin,
+                                        name="latch-worker")
+
+    def _spin(self):
+        # tpulint: disable=TPL1501 -- fixture: monotone latch, both
+        # writers store True and readers tolerate staleness
+        self.stop = True  # EXPECT-SUPPRESSED: TPL1501
+
+    def halt(self):
+        # tpulint: disable=TPL1501 -- fixture: same monotone latch as
+        # the worker-side write above
+        self.stop = True  # EXPECT-SUPPRESSED: TPL1501

@@ -1,0 +1,49 @@
+#!/usr/bin/env python
+"""tpurace CLI — cross-module thread-ownership & race analysis (ISSUE 19).
+
+    python tools/race_tpu.py paddle_tpu --fail-on-violation
+    python tools/race_tpu.py paddle_tpu --show-domains
+    python tools/race_tpu.py paddle_tpu --format json
+
+Unlike per-file ``make lint`` (which folds in each file's OWN slice of
+the TPL1500 family), this sweep analyzes the whole tree in one pass, so
+thread roots in one module (``frontend.py`` spawning
+``paddle-engine-core``) reach attribute accesses in another. The
+analysis package is pure stdlib; this shim loads it WITHOUT importing
+the ``paddle_tpu`` package root (which pulls in jax and initializes a
+backend), so ``make races`` stays fast and runs even on a box with a
+broken accelerator install.
+"""
+import importlib.util
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_analysis():
+    """Import paddle_tpu.analysis as a standalone package, bypassing
+    paddle_tpu/__init__.py (and with it the jax import)."""
+    pkg_dir = os.path.join(_REPO, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        "paddle_tpu.analysis", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    if "paddle_tpu" not in sys.modules:
+        # parent placeholder so relative imports inside analysis resolve;
+        # never executes paddle_tpu/__init__.py
+        import types
+
+        parent = types.ModuleType("paddle_tpu")
+        parent.__path__ = [os.path.join(_REPO, "paddle_tpu")]
+        sys.modules["paddle_tpu"] = parent
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["paddle_tpu.analysis"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    analysis = _load_analysis()
+    from paddle_tpu.analysis import ownership
+
+    sys.exit(ownership.main())

@@ -52,7 +52,8 @@ chaos:
 		tests/test_moe_serving.py tests/test_multi_step.py \
 		tests/test_api_server.py tests/test_replica_failover.py \
 		tests/test_integrity.py tests/test_kv_tier.py \
-		tests/test_tracing.py tests/test_ownership.py -q
+		tests/test_tracing.py tests/test_ownership.py \
+		tests/test_cluster_serving.py -q
 
 # chaos-serve — the multi-replica failover suite alone (ISSUE 13):
 # SIGKILL/poison a replica mid-stream, assert every client stream

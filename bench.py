@@ -439,6 +439,21 @@ def bench_failover(cfg, on_tpu):
         return {"failover_bench_error": f"{type(e).__name__}: {e}"[:120]}
 
 
+def bench_cluster(cfg, on_tpu):
+    """Cluster-scale serving scenario (ISSUE 20): shared-prefix
+    multi-tenant load over a 3-replica prefill/decode cluster with
+    cross-replica KV handoff and cache-aware placement. Gates: fleet
+    prefix hit rate within 1.2x of a single-giant-cache oracle, mixed
+    p99 TTFT < 2x the unpooled baseline over the jitter floor, zero
+    stream failures."""
+    try:
+        from paddle_tpu.serving.loadgen import bench_cluster_serving
+
+        return bench_cluster_serving(cfg, on_tpu)
+    except Exception as e:
+        return {"cluster_bench_error": f"{type(e).__name__}: {e}"[:120]}
+
+
 def bench_trace(cfg, on_tpu):
     """Request-tracing overhead scenario (ISSUE 18): the span recorder's
     steady-state cost as an interleaved-rep ratio of median scheduling-
@@ -763,6 +778,7 @@ def main():
     moe = bench_moe(decode_cfg, on_tpu)
     slo = bench_slo(decode_cfg, on_tpu)
     failover = bench_failover(decode_cfg, on_tpu)
+    cluster = bench_cluster(decode_cfg, on_tpu)
     integrity = bench_integrity(decode_cfg, on_tpu)
     trace = bench_trace(decode_cfg, on_tpu)
     ownership = bench_ownership(decode_cfg, on_tpu)
@@ -880,6 +896,20 @@ def main():
             metric_total("paddle_tpu_slow_client_cancels_total")),
         "failover_ttft_degrade": failover.get(
             "failover_ttft_degrade", 0.0),
+        # cluster-serving surface (ISSUE 20): prefill->decode KV
+        # shipments, bytes moved, recompute fallbacks and pool resizes
+        # as the registry saw them, beside the cluster block's gates
+        "cluster_handoffs": int(
+            metric_total("paddle_tpu_cluster_handoffs_total")),
+        "cluster_handoff_bytes": int(
+            metric_total("paddle_tpu_cluster_handoff_bytes_total")),
+        "cluster_fallbacks": int(
+            metric_total("paddle_tpu_cluster_fallbacks_total")),
+        "cluster_rebalances": int(
+            metric_total("paddle_tpu_cluster_rebalances_total")),
+        "cluster_hit_rate": cluster.get("cluster_hit_rate", 0.0),
+        "cluster_ttft_degrade": cluster.get(
+            "cluster_ttft_degrade", 0.0),
         # data-integrity surface (ISSUE 14): every audit probe and every
         # detection across the whole run (checkpoint digests, weight
         # audits, KV checksums, shadow recompute), plus the overhead
@@ -962,6 +992,7 @@ def main():
         **moe,
         **slo,
         **failover,
+        **cluster,
         **integrity,
         **trace,
         **ownership,

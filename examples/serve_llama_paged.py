@@ -39,6 +39,67 @@ import numpy as np
 import paddle_tpu as paddle
 
 
+def run_cluster_smoke(model, cfg, args):
+    """``--pools prefill=K,decode=M`` smoke (ISSUE 20): an in-process
+    prefill/decode fleet behind one Router — prompts prefill on the
+    prefill pool, their KV ships to a decode replica (digest-verified,
+    recompute on any failure), shared-prefix streams converge onto warm
+    decode replicas. Prints the handoff/fallback counters the chaos
+    suite and bench_cluster gate on."""
+    import time
+
+    import jax.numpy as jnp
+
+    from paddle_tpu.observability import metric_total
+    from paddle_tpu.serving import (InProcReplica, Router,
+                                    ServingFrontend, parse_pools)
+
+    pools = parse_pools(args.pools)
+    n = sum(pools.values())
+
+    def factory():
+        from paddle_tpu.inference.engine import Engine
+
+        eng = Engine(model, max_slots=4, num_pages=96, page_size=16,
+                     chunk_size=8, dtype=jnp.float32, prefix_cache=True)
+        return ServingFrontend(eng)
+
+    reps = [InProcReplica(factory, name=f"pool-r{i}", index=i)
+            for i in range(n)]
+    router = Router(reps, heartbeat_s=0.05, stall_s=None,
+                    pools=pools, fault_plan=args.fault_inject)
+    router.start()
+    try:
+        deadline = time.perf_counter() + 60.0
+        while router.cluster._page_size is None \
+                and time.perf_counter() < deadline:
+            time.sleep(0.05)  # a sweep feeds geometry into the view
+        rng = np.random.default_rng(0)
+        shared = rng.integers(0, cfg.vocab_size, (32,))
+        tickets = []
+        for i in range(6):
+            prompt = np.concatenate(
+                [shared, rng.integers(0, cfg.vocab_size, (8,))])
+            tickets.append(router.submit(prompt, 12,
+                                         tenant=f"t{i % 2}"))
+        for t in tickets:
+            t.result(timeout=300.0)
+        ok = all(t.failure_reason is None for t in tickets)
+        roles = {r.name: router.cluster.role_of(r) for r in reps}
+        print(f"cluster smoke: pools={pools} roles={roles}")
+        print(f"  streams: {len(tickets)} submitted, "
+              f"{sum(1 for t in tickets if t.done)} done, ok={ok}")
+        print("  handoffs=%d fallbacks=%d shipped_kb=%.1f" % (
+            metric_total("paddle_tpu_cluster_handoffs_total"),
+            metric_total("paddle_tpu_cluster_fallbacks_total"),
+            metric_total("paddle_tpu_cluster_handoff_bytes_total")
+            / 1024.0))
+        if not ok:
+            raise SystemExit("cluster smoke: stream failures")
+    finally:
+        router.shutdown()
+
+
 def run_api_server(eng, args):
     """Serve the OpenAI-compatible streaming API (ISSUE 12) until
     SIGTERM/SIGINT, then drain gracefully: admissions stop (new
@@ -343,6 +404,12 @@ def main():
                     help="SIGTERM drain budget (seconds): in-flight "
                          "streams get this long to finish before being "
                          "cancelled cleanly")
+    ap.add_argument("--pools", default=None, metavar="SPEC",
+                    help="cluster-serving smoke (ISSUE 20): run SPEC "
+                         "(e.g. prefill=1,decode=2) in-process replicas "
+                         "behind one Router — prefill pool + KV handoff "
+                         "+ cache-aware decode placement — then print "
+                         "the handoff counters and exit")
     ap.add_argument("--api-smoke", action="store_true",
                     help="self-smoke (make serve-smoke): start the API "
                          "server, run streaming + unary + chat + 429 "
@@ -422,6 +489,13 @@ def main():
             model, algo=f"weight_only_{args.weight_quant}")
         print(f"weight-only {args.weight_quant}: {swapped} Linears "
               f"swapped, GEMM backend={quant_backend()}")
+
+    if args.pools is not None:
+        run_cluster_smoke(model, cfg, args)
+        _trace_report(args)
+        if server is not None:
+            server.close()
+        return
 
     draft_model = None
     if args.spec == "draft":

@@ -1348,6 +1348,48 @@ class _ModuleAnalyzer:
                           "retry loop (`while True` swallowing an "
                           "exception) without " + " or ".join(missing))
 
+    # -- TPL1601: cluster layer stays above the replica surface ------------
+
+    _CLUSTER_INTERNAL_NAMES = ("Engine", "CacheCoordinator")
+    _CLUSTER_INTERNAL_ATTRS = ("engine", "_fe", "_cache", "_pcache",
+                               "frontend")
+
+    def _check_cluster_surface(self):
+        """TPL1601 — cluster-layer modules only (serving/cluster.py,
+        serving/router.py): the replica surface is the process
+        boundary. An in-proc shortcut (``rep._fe.engine...``) compiles
+        and even works — until the replica is a subprocess worker, and
+        it skips the engine-thread marshalling besides."""
+        parts = self.path.replace("\\", "/").split("/")
+        if not any("serving" in p for p in parts):
+            return
+        base = os.path.basename(self.path)
+        if "cluster" not in base and "router" not in base:
+            return
+        for n in ast.walk(self.tree):
+            if isinstance(n, ast.ImportFrom):
+                for a in n.names:
+                    if a.name in self._CLUSTER_INTERNAL_NAMES:
+                        self._add(
+                            R.CLUSTER_BYPASSES_REPLICA_SURFACE, n,
+                            f"imports {a.name!r} — engine internals stay "
+                            "below the replica surface; add a Replica "
+                            "method instead")
+            elif isinstance(n, ast.Call):
+                tail = _tail_name(n.func)
+                if tail in self._CLUSTER_INTERNAL_NAMES:
+                    self._add(
+                        R.CLUSTER_BYPASSES_REPLICA_SURFACE, n,
+                        f"constructs {tail!r} directly — replicas own "
+                        "their engines; build through a replica factory")
+            elif isinstance(n, ast.Attribute) \
+                    and n.attr in self._CLUSTER_INTERNAL_ATTRS:
+                self._add(
+                    R.CLUSTER_BYPASSES_REPLICA_SURFACE, n,
+                    f"touches replica internal `.{n.attr}` — go through "
+                    "the replica surface (ready/export_kv/import_kv/"
+                    "...) so subprocess replicas behave identically")
+
     def _check_module_wide(self):
         self._check_error_handling()
         self._check_integrity_handling()
@@ -1358,6 +1400,7 @@ class _ModuleAnalyzer:
         self._check_multihost_divergence()
         self._check_async_blocking()
         self._check_retry_loops()
+        self._check_cluster_surface()
         # TPL304: module-bound donating wrappers are callable from any
         # function below, so function scopes inherit the module's set
         module_wrappers = self._collect_donating_wrappers(self.tree)

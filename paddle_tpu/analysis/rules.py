@@ -361,5 +361,19 @@ EVENT_LOOP_STATE_FROM_THREAD = _rule(
     "the loop. Trampoline the mutation with call_soon_threadsafe, the "
     "way the SSE bridge forwards engine-thread chunks.")
 
+CLUSTER_BYPASSES_REPLICA_SURFACE = _rule(
+    "TPL1601", "cluster", "cluster-bypasses-replica-surface",
+    "cluster-layer code (serving/cluster.py, serving/router.py) "
+    "reaches into a replica's internals — importing/constructing "
+    "Engine or CacheCoordinator, or touching `.engine`/`._fe`/"
+    "`.frontend`/`._cache`/`._pcache` on a replica — instead of going "
+    "through the replica surface (ready/export_kv/import_kv/...). The "
+    "surface is the process boundary: an in-proc shortcut compiles but "
+    "silently breaks the moment the replica is a subprocess worker, "
+    "and it bypasses the engine-thread marshalling (ServingFrontend."
+    "call) that keeps the single-threaded engine safe. Route the "
+    "access through a Replica method; if none fits, add one to the "
+    "surface so BOTH transports implement it.")
+
 
 FAMILIES = sorted({r.family for r in RULES.values()})

@@ -215,6 +215,21 @@ class CacheCoordinator:
         if self.tier is not None:
             self.tier.stop()
 
+    # ------------------------------------------------ cluster handoff
+    def export_handoff(self, tokens) -> Optional[dict]:
+        """Capture the prompt's cached KV pages into a wire payload for
+        a cross-replica handoff (ISSUE 20) — the prefill side of the
+        prefill/decode pool split. Engine thread; blocks on the
+        device→host fetch (delegated to the spill-named kv_tier helper,
+        the designated blocking-copy site), so the cluster layer must
+        reach it through ``ServingFrontend.call`` from its handoff
+        thread. None when nothing is cached for the prompt."""
+        if self.pcache is None:
+            return None
+        from .kv_tier import capture_handoff_spill
+
+        return capture_handoff_spill(self.engine, tokens)
+
     # ----------------------------------------------------- COW / faults
     def flush_cow(self, copy_fn):
         """Flush pending copy-on-write page duplications in one device

@@ -183,6 +183,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import hashlib
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -1436,6 +1437,107 @@ class Engine:
                 bad = self._integrity.note_registered(pages)
                 if bad:
                     self._contain_kv_corruption(bad)
+
+    def adopt_kv_pages(self, payload) -> int:
+        """Decode-side adoption of a cross-replica KV handoff payload
+        (ISSUE 20): digest-verify the shipped page rows, restore them
+        into freshly allocated pool pages, and publish them in the
+        prefix cache so the next admission of the same prompt splices
+        instead of recomputing. Engine thread (the cluster reaches it
+        through ``ServingFrontend.call``). Returns the number of pages
+        adopted; 0 on any mismatch/pressure — the caller's fallback is
+        plain resume-from-emitted recompute, so a bad payload costs a
+        cache miss, never a stall or a wrong token.
+
+        Verification truncates at the FIRST digest mismatch: chain keys
+        commit to the whole prefix, so a clean prefix of the shipment
+        is still independently trustworthy. Blocks the local cache
+        already holds HBM-resident are skipped (first-writer-wins, same
+        as ``PrefixCache.register``); a shipped block whose entry is
+        host-tier re-binds to the restored page (recompute-as-promote,
+        minus the recompute)."""
+        if self._pcache is None or not payload:
+            return 0
+        pc = self._pcache
+        if int(payload.get("page_size", -1)) != self.page_size:
+            return 0
+        tokens = np.asarray(payload.get("tokens", ()), np.int32)
+        rows_per_page = payload.get("pages") or []
+        digests = payload.get("digests") or []
+        dev_sums = payload.get("dev_sums") or [None] * len(rows_per_page)
+        n_blocks = min(tokens.size // self.page_size,
+                       len(rows_per_page), len(digests))
+        good = 0
+        for j in range(n_blocks):
+            d = hashlib.blake2b(digest_size=16)
+            for a in rows_per_page[j]:
+                d.update(np.ascontiguousarray(a).tobytes())
+            if d.hexdigest() != digests[j]:
+                break  # later blocks chain through this one: truncate
+            good += 1
+        from .integrity import count_integrity_check
+
+        count_integrity_check("kv_handoff", good == n_blocks)
+        if not good:
+            return 0
+        # skip what is already resident (peek, no stamp/accounting) —
+        # re-restoring an identical block would only burn a page
+        _, matched = pc.lookup(tokens[:good * self.page_size],
+                               touch=False)
+        start = matched // self.page_size
+        fresh = []  # (block_index, page)
+        for j in range(start, good):
+            page = self._cache.alloc_page()
+            if page is None:
+                break  # pool pressure: adopt the prefix that fits
+            fresh.append((j, int(page)))
+        if not fresh:
+            return 0
+        import jax.numpy as jnp
+
+        w = 32  # fixed-width restore waves (HostTier.COPY_WIDTH idiom)
+        for off in range(0, len(fresh), w):
+            chunk = fresh[off:off + w]
+            m = len(chunk)
+            idx = np.zeros((w,), np.int32)
+            idx[:m] = [p for _, p in chunk]
+            stacked = [
+                np.stack([np.asarray(rows_per_page[j][i])
+                          for j, _ in chunk]
+                         + [np.zeros_like(
+                             np.asarray(rows_per_page[chunk[0][0]][i]))]
+                         * (w - m))
+                for i in range(len(rows_per_page[chunk[0][0]]))
+            ]
+            self._cache.set_pages(self.runner.restore_pages(
+                self._cache.pages_flat(), jnp.asarray(idx), stacked))
+        end = fresh[-1][0] + 1
+        blocks = tokens[:end * self.page_size]
+        row = [0] * end
+        for j, p in fresh:
+            row[j] = p
+        pc.register(blocks, row)
+        adopted = 0
+        for j, p in fresh:
+            # uniform release: ref 1 -> 0; a page the register adopted
+            # stays resident (cache-owned, LRU-evictable), a page an
+            # existing entry beat stays off the index and returns to the
+            # free list — leak-free either way
+            registered = pc.contains_page(p)
+            self._cache.release_page(p)
+            if not registered:
+                continue
+            adopted += 1
+            if self._integrity is not None and dev_sums[j] is not None:
+                # the shipped bytes hash-matched their capture digest,
+                # so the source replica's device-side sum describes the
+                # restored page too (same contract as tier promotion)
+                self._integrity.adopt_page_sum(p, float(dev_sums[j]))
+        if _TRACER.enabled:
+            _TRACER.instant("cluster.kv_adopt", "cache",
+                            adopted=int(adopted),
+                            shipped=int(n_blocks), verified=int(good))
+        return adopted
 
     def _contain_kv_corruption(self, bad_pages):
         """Containment ladder, KV arm (ISSUE 14): a checksum-failed page

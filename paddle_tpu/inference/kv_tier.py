@@ -65,7 +65,70 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-__all__ = ["HostTier", "bench_kv_tier"]
+__all__ = ["HostTier", "bench_kv_tier", "capture_handoff_spill"]
+
+# capture/restore waves use one fixed index width (mirrors
+# HostTier.COPY_WIDTH): a per-wave width would mint a fresh XLA program
+# per distinct size
+_HANDOFF_COPY_WIDTH = 32
+
+
+def capture_handoff_spill(engine, tokens) -> Optional[dict]:
+    """Capture the prompt's cached KV pages into a host-side handoff
+    slab (ISSUE 20): the cross-replica twin of the demotion capture
+    above. Engine thread; BLOCKS on the device→host fetch — the name
+    carries the ``spill`` hint because this is a deliberate second
+    blocking-copy site (tpulint TPL1101), invoked only on the cluster's
+    dedicated handoff thread via ``ServingFrontend.call``, never from
+    the scheduling loop.
+
+    Returns the wire payload — per-page buffer rows in ``pages_flat``
+    order plus a per-page blake2b digest (chain-contiguous from the
+    root, so the importer can truncate at the first mismatch) and the
+    integrity sentinel's device-side sums — or ``None`` when nothing is
+    cached for the prompt (the caller falls back to recompute). Only
+    the HBM-resident chain prefix ships: host-tier tails would need a
+    promote round trip that costs more than the recompute they save."""
+    import jax
+    import jax.numpy as jnp
+
+    coord = getattr(engine, "_cache", None)
+    pc = getattr(engine, "_pcache", None)
+    if coord is None or pc is None:
+        return None
+    pages, matched = pc.lookup(tokens, touch=False)
+    if not pages:
+        return None
+    ps = int(pc.page_size)
+    ig = getattr(engine, "_integrity", None)
+    w = _HANDOFF_COPY_WIDTH
+    rows_per_page: List[List[np.ndarray]] = []
+    for off in range(0, len(pages), w):
+        chunk = pages[off:off + w]
+        idx = np.zeros((w,), np.int32)
+        idx[:len(chunk)] = chunk
+        handles = engine.runner.capture_pages(coord.pages_flat(),
+                                              jnp.asarray(idx))
+        arrays = [np.asarray(jax.device_get(h)) for h in handles]
+        for j in range(len(chunk)):
+            rows_per_page.append([np.array(a[j]) for a in arrays])
+    digests, nbytes = [], 0
+    for rows in rows_per_page:
+        d = hashlib.blake2b(digest_size=16)
+        for a in rows:
+            d.update(a.tobytes())
+            nbytes += a.nbytes
+        digests.append(d.hexdigest())
+    dev_sums = [None if ig is None else ig.sum_of_page(p) for p in pages]
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    return {
+        "tokens": [int(t) for t in toks[:matched]],
+        "page_size": ps,
+        "digests": digests,
+        "pages": rows_per_page,
+        "dev_sums": dev_sums,
+        "nbytes": int(nbytes),
+    }
 
 
 def _pow2ceil(n: int) -> int:

@@ -80,7 +80,30 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "chain_keys"]
+
+
+def chain_keys(tokens, page_size: int) -> List[bytes]:
+    """The chain-hash keys for every FULL block of ``tokens``.
+
+    Block ``i``'s key is ``blake2b(parent_key || tokens_i)``, so a key
+    commits to the entire prefix through its block. This is the SAME
+    derivation ``PrefixCache._chain`` uses — it is public so the cluster
+    router (``serving/cluster.py``) can score a prompt against the
+    chain digests replicas report in their readiness payload without
+    holding a cache instance: matching hex keys means matching token
+    prefixes, replica-independently."""
+    ps = int(page_size)
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
+    out: List[bytes] = []
+    parent = b""
+    for i in range(toks.size // ps):
+        block = toks[i * ps:(i + 1) * ps]
+        key = hashlib.blake2b(parent + block.tobytes(),
+                              digest_size=16).digest()
+        out.append(key)
+        parent = key
+    return out
 
 
 class _Entry:
@@ -131,15 +154,9 @@ class PrefixCache:
         """(key, block_tokens) for every FULL block of ``tokens``."""
         ps = self.page_size
         toks = np.ascontiguousarray(np.asarray(tokens, np.int32))
-        out = []
-        parent = b""
-        for i in range(toks.size // ps):
-            block = toks[i * ps:(i + 1) * ps]
-            key = hashlib.blake2b(parent + block.tobytes(),
-                                  digest_size=16).digest()
-            out.append((key, block))
-            parent = key
-        return out
+        keys = chain_keys(toks, ps)
+        return [(key, toks[i * ps:(i + 1) * ps])
+                for i, key in enumerate(keys)]
 
     # ----------------------------------------------------------- lookup
     def lookup(self, tokens, touch: bool = True, tiers: bool = False):

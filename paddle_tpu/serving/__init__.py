@@ -25,12 +25,19 @@ The layer that turns the paged ``inference.Engine`` into a *service*:
   health-gated routing, and KV-free mid-stream request migration —
   a dead replica's streams re-admit elsewhere as prompt‖emitted and
   the client sees one uninterrupted, bit-identical token sequence.
+* :mod:`cluster` — cluster-scale serving (ISSUE 20):
+  ``Router(pools={"prefill": k, "decode": m})`` splits the fleet into
+  role pools, ships finished prefill KV across replicas
+  (digest-verified; every failure degrades to resume-from-emitted
+  recompute), scores placement by prefix-chain overlap before load,
+  and autoscales pools from queue-depth/p99-TTFT signals.
 
 The package itself is stdlib+numpy; only the frontend's engine thread
 ever touches jax/compiled programs — the event loop and the fair queue
 never do (tpulint TPL901 keeps it that way; TPL902 additionally bans
 unbounded retry loops anywhere in this package).
 """
+from .cluster import ClusterCoordinator, parse_pools
 from .fairness import DEFAULT_TENANT, FairQueue, parse_tenant_weights
 from .frontend import ServingFrontend, StreamTicket
 from .replica import InProcReplica, Replica, StreamSpec, SubprocessReplica
@@ -41,4 +48,5 @@ __all__ = [
     "ServingFrontend", "StreamTicket",
     "Replica", "InProcReplica", "SubprocessReplica", "StreamSpec",
     "Router", "RouterTicket",
+    "ClusterCoordinator", "parse_pools",
 ]

@@ -231,6 +231,7 @@ class ServingFrontend:
         self._live: Dict[int, StreamTicket] = {}  # rid -> ticket
         self._reqs: Dict[int, object] = {}        # rid -> engine Request
         self._cancels: deque = deque()
+        self._calls: deque = deque()  # (fn, box): engine-thread errands
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._drained = threading.Event()
@@ -272,18 +273,42 @@ class ServingFrontend:
         queued = len(self.queue) + len(eng._queue)
         ready = (self.alive and not self._draining and wd["ready"]
                  and queued <= self.ready_queue_depth)
-        return {"ready": bool(ready), "alive": self.alive,
-                "draining": self._draining,
-                "watchdog_level": wd["level"],
-                "watchdog_mode": wd["mode"],
-                # integrity quarantine (ISSUE 14): tells the router to
-                # migrate IN-FLIGHT streams too, not just stop routing
-                # new ones — corrupt weights poison existing streams'
-                # future tokens, unlike ordinary degradation
-                "quarantined": bool(wd.get("quarantined", False)),
-                "queue_depth": queued,
-                "active": len(eng._active),
-                "inflight": len(self._live) + queued}
+        out = {"ready": bool(ready), "alive": self.alive,
+               "draining": self._draining,
+               "watchdog_level": wd["level"],
+               "watchdog_mode": wd["mode"],
+               # integrity quarantine (ISSUE 14): tells the router to
+               # migrate IN-FLIGHT streams too, not just stop routing
+               # new ones — corrupt weights poison existing streams'
+               # future tokens, unlike ordinary degradation
+               "quarantined": bool(wd.get("quarantined", False)),
+               "queue_depth": queued,
+               "active": len(eng._active),
+               "inflight": len(self._live) + queued}
+        # cluster placement payload (ISSUE 20): the chain-hash digests
+        # of every cached prefix block (any tier — host-resident blocks
+        # promote on the hit this report attracts), plus the geometry a
+        # handoff peer needs. Racy-by-design like the fields above; the
+        # engine thread mutates the cache dict concurrently, so a torn
+        # iteration degrades to omitting the field — which is EXACTLY
+        # the versioned-payload fallback the router must tolerate from
+        # older replicas anyway (availability-only routing).
+        try:
+            pc = eng._pcache
+            if pc is not None:
+                out["kv_chains"] = [
+                    k.hex() for k in list(pc._by_key)
+                ][:self.KV_CHAINS_REPORT_MAX]
+            out["page_size"] = int(eng.page_size)
+            out["eos_id"] = eng.eos_id
+        except Exception:  # pragma: no cover - racy dict resize
+            pass
+        return out
+
+    # bound on the readiness payload's chain-digest report: 4096 hex
+    # keys ≈ 128 KiB — plenty for placement scoring (it covers 4096
+    # cached blocks) without turning every heartbeat into a bulk scrape
+    KV_CHAINS_REPORT_MAX = 4096
 
     def poison(self):
         """Simulate sudden replica death (the chaos surface behind the
@@ -327,6 +352,42 @@ class ServingFrontend:
         ticket.tenant = self.queue.submit(ticket, tenant=tenant, cost=cost)
         self._wake.set()
         return ticket
+
+    def call(self, fn: Callable, timeout: float = 10.0):
+        """Run ``fn()`` ON the engine thread and block for its result
+        (any OTHER thread). The engine is single-threaded by contract —
+        every ``Engine`` touch must happen on the loop below — so
+        cross-thread errands (the cluster KV handoff's export/adopt,
+        ISSUE 20) marshal through this deque exactly like ``_cancels``
+        do. Raises whatever ``fn`` raised, or ``TimeoutError`` when the
+        loop did not get to it in time (a dead/poisoned engine thread
+        degrades the caller to its fallback, never a hang)."""
+        if not self.alive:
+            raise RuntimeError("engine thread is not running")
+        box = {"evt": threading.Event(), "result": None, "exc": None}
+        self._calls.append((fn, box))
+        self._wake.set()
+        if not box["evt"].wait(timeout):
+            raise TimeoutError("engine thread did not run the call "
+                               f"within {timeout}s")
+        if box["exc"] is not None:
+            raise box["exc"]
+        return box["result"]
+
+    # ---------------------------------------------- cluster KV handoff
+    def export_kv(self, tokens, timeout: float = 10.0) -> Optional[Dict]:
+        """Capture the prompt's cached KV pages into a handoff payload
+        (ISSUE 20, prefill side). Runs on the engine thread via
+        :meth:`call`; None when nothing is cached."""
+        return self.call(
+            lambda: self.engine._cache.export_handoff(tokens), timeout)
+
+    def import_kv(self, payload, timeout: float = 10.0) -> int:
+        """Adopt a shipped handoff payload into this replica's pool
+        (ISSUE 20, decode side). Digest-verified by the engine; returns
+        pages adopted (0 = caller falls back to recompute)."""
+        return self.call(
+            lambda: self.engine.adopt_kv_pages(payload), timeout)
 
     def cancel(self, ticket: StreamTicket):
         """Cancel a stream (any thread): a queued ticket dies in the
@@ -444,6 +505,19 @@ class ServingFrontend:
             self._live[req.rid] = ticket
             self._reqs[req.rid] = req
 
+    def _apply_calls(self):
+        """Drain cross-thread errands (engine thread): each ``call()``
+        runs here, between scheduling steps, so the engine stays
+        single-threaded while other threads (the cluster handoff) get
+        results back."""
+        while self._calls:
+            fn, box = self._calls.popleft()
+            try:
+                box["result"] = fn()
+            except Exception as e:  # noqa: BLE001 - travels to caller
+                box["exc"] = e
+            box["evt"].set()
+
     def _apply_cancels(self):
         while self._cancels:
             ticket = self._cancels.popleft()
@@ -511,6 +585,7 @@ class ServingFrontend:
                     # router's failover machinery is what must react.
                     return
                 self._apply_cancels()
+                self._apply_calls()
                 self._cancel_stalled()
                 if self._force_cancel:
                     for rid in list(self._live):
@@ -562,4 +637,10 @@ class ServingFrontend:
                 eng._cache.shutdown_tier()
             except Exception:  # pragma: no cover - teardown best-effort
                 pass
+            # fail pending cross-thread errands NOW instead of letting
+            # their callers ride out the full call() timeout
+            while self._calls:
+                _fn, box = self._calls.popleft()
+                box["exc"] = RuntimeError("engine thread exited")
+                box["evt"].set()
             self._drained.set()

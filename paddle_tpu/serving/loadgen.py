@@ -38,7 +38,8 @@ import numpy as np
 from .frontend import ServingFrontend
 
 __all__ = ["run_open_loop", "run_closed_loop", "bench_slo_serving",
-           "bench_failover_serving", "bench_trace_serving"]
+           "bench_failover_serving", "bench_trace_serving",
+           "bench_cluster_serving"]
 
 
 def _percentile(xs: List[float], q: float) -> float:
@@ -656,4 +657,162 @@ def bench_failover_serving(cfg, on_tpu: bool) -> Dict:
         "failover_ok": bool(degrade < 2.0 and completed == requests
                             and migrated >= 1),
     }
+    return out
+
+
+def bench_cluster_serving(cfg, on_tpu: bool) -> Dict:
+    """The ISSUE 20 acceptance block: a shared-prefix multi-tenant
+    workload over a 3-replica prefill/decode cluster. Gates:
+
+    * **zero stream failures** — every request completes on both the
+      pooled fleet and the unpooled baseline;
+    * **hit rate within 1.2x of the single-giant-cache oracle** — the
+      fleet's aggregate prefix-cache hit rate (prefill pool warm per
+      tenant, decode pool warmed by handoff adoption + cache-aware
+      placement) must not fall more than 1.2x below ONE engine holding
+      every tenant's prefix in one cache;
+    * **mixed p99 TTFT < 2x the unpooled baseline** over the jitter
+      floor — disaggregation (prefill leg + handoff + decode leg) must
+      not tax time-to-first-token, which the prefill pool serves
+      directly.
+
+    ``paddle_tpu_cluster_{handoffs,handoff_bytes,fallbacks}_total``
+    land in bench.py's metrics block from this run.
+    """
+    from ..inference.engine import Engine
+    from ..models.gpt import GPTForCausalLM
+    from ..observability import metric_total
+    from .replica import InProcReplica
+    from .router import Router
+
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    model.bfloat16()
+    vocab = cfg.vocab_size
+    slots = 4
+    page = 16
+    qps = 20.0 if on_tpu else 6.0
+    n_req = 64 if on_tpu else 24
+    budget = 8
+    tenants = 4
+    rng0 = np.random.default_rng(7)
+    # one fixed 2-page prefix per tenant: the shareable unit every
+    # placement/caching claim below is about
+    prefixes = [_mk_prompt(rng0, vocab, 2 * page, 2 * page + 1)
+                for _ in range(tenants)]
+
+    def warm_engine(num_pages):
+        eng = Engine(model, max_slots=slots, num_pages=num_pages,
+                     page_size=page, chunk_size=1, max_chain=1,
+                     prefix_cache=True)
+        _precompile(eng, seq_buckets=(64,))
+        return eng
+
+    fleet_pages = (slots + 2) * cfg.max_position // page + 1
+
+    def hit_rate_delta(h0, m0):
+        dh = metric_total("paddle_tpu_prefix_cache_hits_total") - h0
+        dm = metric_total("paddle_tpu_prefix_cache_misses_total") - m0
+        return dh / (dh + dm) if (dh + dm) else 0.0
+
+    def workload(submit, seed):
+        rng = np.random.default_rng(seed)
+        gaps = rng.exponential(1.0 / qps, size=n_req)
+        tickets = []
+        next_at = time.perf_counter()
+        for i in range(n_req):
+            next_at += gaps[i]
+            delay = next_at - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+            prompt = np.concatenate([prefixes[i % tenants],
+                                     _mk_prompt(rng, vocab, 8, 17)])
+            tickets.append(submit(prompt, budget,
+                                  tenant=f"t{i % tenants}"))
+        for t in tickets:
+            t.result(timeout=300.0)
+        ttft = [t.ttft_s for t in tickets if t.ttft_s is not None]
+        return {
+            "completed": sum(1 for t in tickets
+                             if t.done and not t.failure_reason),
+            "requests": len(tickets),
+            "p99_ttft_ms": 1e3 * _percentile(ttft, 99),
+        }
+
+    fail0 = metric_total("paddle_tpu_request_failures_total")
+
+    # --- unpooled baseline: same 3 engines, every replica does both
+    base_reps = [InProcReplica(
+        lambda: ServingFrontend(warm_engine(fleet_pages)),
+        name=f"base-r{i}", index=i) for i in range(3)]
+    base_router = Router(base_reps, heartbeat_s=0.05,
+                         stall_s=None).start()
+    base = workload(base_router.submit, seed=100)
+    base_router.shutdown()
+
+    # --- pooled cluster: 1 prefill + 2 decode, KV handoff between
+    reps = [InProcReplica(
+        lambda: ServingFrontend(warm_engine(fleet_pages)),
+        name=f"cluster-r{i}", index=i) for i in range(3)]
+    router = Router(reps, heartbeat_s=0.05, stall_s=None,
+                    pools={"prefill": 1, "decode": 2}).start()
+    deadline = time.perf_counter() + 30.0
+    while router.cluster._page_size is None \
+            and time.perf_counter() < deadline:
+        time.sleep(0.02)  # one sweep feeds geometry into the view
+    h0 = metric_total("paddle_tpu_prefix_cache_hits_total")
+    m0 = metric_total("paddle_tpu_prefix_cache_misses_total")
+    ho0 = metric_total("paddle_tpu_cluster_handoffs_total")
+    hb0 = metric_total("paddle_tpu_cluster_handoff_bytes_total")
+    fb0 = metric_total("paddle_tpu_cluster_fallbacks_total")
+    pooled = workload(router.submit, seed=200)
+    pooled_rate = hit_rate_delta(h0, m0)
+    handoffs = metric_total("paddle_tpu_cluster_handoffs_total") - ho0
+    handoff_mb = (metric_total("paddle_tpu_cluster_handoff_bytes_total")
+                  - hb0) / 2 ** 20
+    fallbacks = metric_total("paddle_tpu_cluster_fallbacks_total") - fb0
+    router.shutdown()
+
+    # --- oracle: ONE engine whose cache could hold the whole fleet's
+    # prefixes — the upper bound cluster hit rate is judged against
+    oracle_fe = ServingFrontend(warm_engine(4 * fleet_pages)).start()
+    h0 = metric_total("paddle_tpu_prefix_cache_hits_total")
+    m0 = metric_total("paddle_tpu_prefix_cache_misses_total")
+    oracle = workload(oracle_fe.submit, seed=300)
+    oracle_rate = hit_rate_delta(h0, m0)
+    oracle_fe.shutdown()
+
+    floor_ms = 20.0 if on_tpu else 50.0
+    degrade = (pooled["p99_ttft_ms"]
+               / max(base["p99_ttft_ms"], floor_ms))
+    completed = (base["completed"] + pooled["completed"]
+                 + oracle["completed"])
+    requests = (base["requests"] + pooled["requests"]
+                + oracle["requests"])
+    zero_failures = bool(
+        completed == requests
+        and metric_total("paddle_tpu_request_failures_total") == fail0)
+    hit_ok = bool(pooled_rate * 1.2 >= oracle_rate)
+    out = {
+        "cluster_requests_per_run": n_req,
+        "cluster_tenants": tenants,
+        "cluster_qps": qps,
+        "cluster_hit_rate": round(pooled_rate, 3),
+        "cluster_oracle_hit_rate": round(oracle_rate, 3),
+        "cluster_hit_rate_ok": hit_ok,
+        "cluster_p99_ttft_ms": round(pooled["p99_ttft_ms"], 1),
+        "cluster_baseline_p99_ttft_ms": round(base["p99_ttft_ms"], 1),
+        "cluster_ttft_floor_ms": floor_ms,
+        "cluster_ttft_degrade": round(degrade, 3),
+        "cluster_handoffs": int(handoffs),
+        "cluster_handoff_mb": round(handoff_mb, 3),
+        "cluster_fallbacks": int(fallbacks),
+        "cluster_zero_failures": zero_failures,
+        "cluster_ok": bool(hit_ok and degrade < 2.0 and zero_failures),
+    }
+    if not out["cluster_ok"]:
+        print(f"WARNING: cluster serving gate failed: hit_rate="
+              f"{pooled_rate:.3f} vs oracle {oracle_rate:.3f} (1.2x), "
+              f"ttft_degrade={degrade:.3f} (<2.0), "
+              f"zero_failures={zero_failures}")
     return out

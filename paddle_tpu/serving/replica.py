@@ -44,6 +44,7 @@ dedicated threads, never an event loop (tpulint TPL901 guards that).
 """
 from __future__ import annotations
 
+import base64
 import http.client
 import json
 import signal
@@ -51,8 +52,50 @@ import subprocess
 import threading
 from typing import Callable, Dict, List, Optional, Sequence
 
+import numpy as np
+
 __all__ = ["Replica", "InProcReplica", "SubprocessReplica",
-           "StreamSpec", "ReplicaStream"]
+           "StreamSpec", "ReplicaStream",
+           "encode_kv_payload", "decode_kv_payload"]
+
+
+# --------------------------------------------------- KV handoff codec
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # bfloat16 etc. register through ml_dtypes (jax ships it)
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def encode_kv_payload(payload: Dict) -> Dict:
+    """JSON-encode a KV handoff payload (ISSUE 20): page buffer rows
+    become ``{dtype, shape, b64}`` triples so the payload can cross the
+    subprocess transport (``/v1/kv``). Digests/dev_sums/tokens are
+    already JSON-native."""
+    out = dict(payload)
+    out["pages"] = [
+        [{"dtype": str(a.dtype), "shape": list(a.shape),
+          "b64": base64.b64encode(
+              np.ascontiguousarray(a).tobytes()).decode("ascii")}
+         for a in rows]
+        for rows in payload["pages"]]
+    return out
+
+
+def decode_kv_payload(obj: Dict) -> Dict:
+    """Inverse of :func:`encode_kv_payload`. The decoded rows are
+    read-only views over the b64 bytes — the adopter only hashes and
+    stacks them, never writes in place."""
+    out = dict(obj)
+    out["pages"] = [
+        [np.frombuffer(base64.b64decode(d["b64"]),
+                       dtype=_np_dtype(d["dtype"])).reshape(d["shape"])
+         for d in rows]
+        for rows in obj["pages"]]
+    return out
 
 
 class StreamSpec:
@@ -196,6 +239,22 @@ class Replica:
     def _cancel(self, stream: ReplicaStream):
         raise NotImplementedError
 
+    # ------------------------------------------------ cluster handoff
+    # Default = "this replica does not speak the handoff protocol":
+    # export yields nothing and import adopts nothing, so a cluster
+    # pairing an OLDER replica degrades to resume-from-emitted
+    # recompute — the same versioned-payload fallback the readiness
+    # kv_chains field rides (ISSUE 20 small fix). Never an error.
+    def export_kv(self, tokens: Sequence[int]) -> Optional[Dict]:
+        """Capture ``tokens``' cached KV pages into a handoff payload
+        (prefill side); None when unsupported or nothing is cached."""
+        return None
+
+    def import_kv(self, payload: Dict) -> int:
+        """Adopt a shipped payload into this replica's pool (decode
+        side); returns pages adopted (0 = caller recomputes)."""
+        return 0
+
 
 class InProcReplica(Replica):
     """An Engine+ServingFrontend replica in this process. ``factory()``
@@ -280,6 +339,25 @@ class InProcReplica(Replica):
         if stream._impl is not None and self._fe is not None \
                 and self._fe.alive:
             self._fe.cancel(stream._impl)
+
+    # ------------------------------------------------ cluster handoff
+    def export_kv(self, tokens: Sequence[int]) -> Optional[Dict]:
+        """In-process handoff export: the payload is a shared host-slab
+        reference (numpy rows), no serialization round trip."""
+        if self._fe is None or not self._fe.alive:
+            return None
+        try:
+            return self._fe.export_kv(tokens)
+        except Exception:
+            return None  # dead/poisoned engine thread: recompute
+
+    def import_kv(self, payload: Dict) -> int:
+        if self._fe is None or not self._fe.alive or not payload:
+            return 0
+        try:
+            return int(self._fe.import_kv(payload))
+        except Exception:
+            return 0
 
 
 class SubprocessReplica(Replica):
@@ -479,3 +557,49 @@ class SubprocessReplica(Replica):
                 conn.close()  # server's disconnect-cancel frees the slot
             except Exception:
                 pass
+
+    # ------------------------------------------------ cluster handoff
+    def _post_json(self, path: str, body: Dict, timeout: float):
+        conn = http.client.HTTPConnection(self.host, self.port,
+                                          timeout=timeout)
+        try:
+            conn.request("POST", path, json.dumps(body),
+                         {"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read() or b"{}")
+        finally:
+            conn.close()
+
+    # a handoff is worth at most one prefill recompute; a transfer
+    # slower than this budget is the kv-handoff-stall signature and the
+    # caller falls back to recompute rather than wait
+    KV_HANDOFF_TIMEOUT_S = 10.0
+
+    def export_kv(self, tokens: Sequence[int]) -> Optional[Dict]:
+        """Subprocess handoff export over the ``/v1/kv`` endpoint —
+        page rows ride base64 (``encode_kv_payload`` on the worker,
+        decoded here back into numpy rows)."""
+        if not self.alive():
+            return None
+        try:
+            status, obj = self._post_json(
+                "/v1/kv", {"op": "export",
+                           "tokens": [int(t) for t in tokens]},
+                self.KV_HANDOFF_TIMEOUT_S)
+            if status != 200 or not obj.get("payload"):
+                return None
+            return decode_kv_payload(obj["payload"])
+        except Exception:
+            return None
+
+    def import_kv(self, payload: Dict) -> int:
+        if not self.alive() or not payload:
+            return 0
+        try:
+            status, obj = self._post_json(
+                "/v1/kv", {"op": "import",
+                           "payload": encode_kv_payload(payload)},
+                self.KV_HANDOFF_TIMEOUT_S)
+            return int(obj.get("adopted", 0)) if status == 200 else 0
+        except Exception:
+            return 0

@@ -52,6 +52,16 @@ chaos suite assert on these.
 Client callbacks fire from replica-owned threads; RouterTicket does the
 locking. Stdlib-only (tickets mirror StreamTicket's surface, so the
 SLO load generator drives a Router exactly like a ServingFrontend).
+
+ISSUE 20 layers :mod:`~paddle_tpu.serving.cluster` above this router:
+``Router(pools={"prefill": k, "decode": m})`` activates role pools,
+cross-replica KV handoff between the prefill and decode legs, and
+prefix-cache-aware placement. The router keeps owning supervision,
+migration, and retry; the coordinator only SHAPES placements (role
+filter, cache scoring, prefill budget cap) and intercepts clean prefill
+completions to continue them on the decode pool. TTFT hedging is
+disabled in pool mode — a hedge duplicates the FULL spec, which would
+put prefill-sized work back on decode replicas.
 """
 from __future__ import annotations
 
@@ -90,6 +100,10 @@ class RouterTicket:
         self.cancelled = False
         self.migrations = 0
         self.hedged = False
+        # cluster phase (ISSUE 20): None outside pool mode, else
+        # "prefill" -> "handoff" -> "decode"; written under _cond (the
+        # coordinator's handoff thread and _place both touch it)
+        self.phase: Optional[str] = None
         self.replica: Optional[str] = None  # current host replica name
         self.t_submit = time.perf_counter()
         self.t_first: Optional[float] = None
@@ -228,7 +242,11 @@ class Router:
                  max_migrations: int = 3,
                  restart_dead: bool = True,
                  restart_backoff_s: float = 0.2,
-                 restart_backoff_cap_s: float = 5.0):
+                 restart_backoff_cap_s: float = 5.0,
+                 pools: Optional[Dict[str, int]] = None,
+                 replica_factory: Optional[Callable] = None,
+                 handoff_budget_s: float = 5.0,
+                 autoscale: Optional[Dict] = None):
         if not replicas:
             raise ValueError("Router needs at least one replica")
         self.replicas = list(replicas)
@@ -271,6 +289,14 @@ class Router:
             "replicas fenced off after an integrity-audit failure "
             "(weight corruption): streams migrated, replica killed and "
             "supervised-restarted with verified weights")
+        # cluster mode (ISSUE 20): pools activates the coordinator;
+        # without it every path below is byte-for-byte PR 13 behavior
+        self.cluster = None
+        if pools:
+            from .cluster import ClusterCoordinator
+            self.cluster = ClusterCoordinator(
+                self, dict(pools), replica_factory=replica_factory,
+                handoff_budget_s=handoff_budget_s, autoscale=autoscale)
 
     # ------------------------------------------------------------ control
     def start(self) -> "Router":
@@ -302,6 +328,8 @@ class Router:
             with self._lock:
                 if idx in self._dead:
                     continue
+            if self.cluster is not None and self.cluster.is_drained(idx):
+                continue
             try:
                 if rep.alive() and rep.ready().get("ready"):
                     out.append(rep)
@@ -309,18 +337,30 @@ class Router:
                 continue
         return out
 
-    def _pick(self, exclude=()) -> Optional[Replica]:
+    def _pick(self, exclude=(), role: Optional[str] = None,
+              spec: Optional[StreamSpec] = None) -> Optional[Replica]:
         """Least-loaded READY replica, falling back to any live one:
         when every survivor is degraded, routing to a degraded replica
-        still beats failing the request."""
+        still beats failing the request. In pool mode ``role`` narrows
+        to that pool (an empty/unready pool borrows cross-role —
+        availability beats purity) and ``spec`` upgrades the pick to
+        the coordinator's prefix-overlap scoring."""
         ready = [r for r in self._ready_replicas() if r not in exclude]
+        if self.cluster is not None and role is not None:
+            pool = [r for r in ready if self.cluster.role_of(r) == role]
+            if pool:
+                ready = pool
         if not ready:
             with self._lock:
                 dead = set(self._dead)
             ready = [r for i, r in enumerate(self.replicas)
-                     if r not in exclude and i not in dead and r.alive()]
+                     if r not in exclude and i not in dead and r.alive()
+                     and not (self.cluster is not None
+                              and self.cluster.is_drained(i))]
         if not ready:
             return None
+        if self.cluster is not None and spec is not None:
+            return self.cluster.choose(ready, spec)
         return min(ready, key=lambda r: r.inflight)
 
     def submit(self, prompt, max_new_tokens: int,
@@ -378,6 +418,11 @@ class Router:
                          # (re)placement: a migrated stream's spans on
                          # the new replica join the ORIGINAL trace
                          trace=spec.trace, t_origin=spec.t_origin)
+        role = None
+        if self.cluster is not None:
+            # pool mode: pick the role pool and (for a fresh prompt
+            # worth disaggregating) cap the prefill leg to one token
+            sub, role = self.cluster.outbound(ticket, sub)
         place = _TRACER.start(
             "router.place", "router", parent=spec.trace,
             resumed=len(resume or ())) if _TRACER.enabled else None
@@ -392,7 +437,8 @@ class Router:
                 # (failover latency is the product here)
                 time.sleep(min(1.0, self.place_backoff_s * (2 **
                                                             (attempt - 1))))
-            rep = self._pick(exclude=exclude if attempt == 0 else ())
+            rep = self._pick(exclude=exclude if attempt == 0 else (),
+                             role=role, spec=sub)
             if rep is None:
                 continue
             # two-phase submit: wire the stream to the ticket BEFORE
@@ -450,6 +496,11 @@ class Router:
                                  or ticket._primary is stream))
         if not load_bearing:
             return  # a cancelled hedge loser reporting in
+        if self.cluster is not None:
+            if failure_reason is None \
+                    and self.cluster.intercept_done(stream, ticket):
+                return  # prefill leg done; the handoff continues it
+            self.cluster.note_done(ticket)
         with self._lock:
             self._tickets.discard(ticket)
         ticket._finish(failure_reason)
@@ -515,6 +566,8 @@ class Router:
         now = time.perf_counter()
         ready_count = 0
         for idx, rep in enumerate(self.replicas):
+            if self.cluster is not None and self.cluster.is_drained(idx):
+                continue  # autoscale-drained: stopped on purpose
             if self._fi is not None and self._fi.fire("replica-crash",
                                                       rid=idx):
                 rep.kill()
@@ -572,11 +625,18 @@ class Router:
                         daemon=True).start()
             elif up:
                 try:
-                    if rep.ready().get("ready"):
+                    payload = rep.ready()
+                    if payload.get("ready"):
                         ready_count += 1
+                    if self.cluster is not None:
+                        # feed the placement view (kv_chains, geometry,
+                        # idle clock) from the same readiness probe
+                        self.cluster.observe(rep, payload)
                 except Exception:
                     pass
         self._m_ready.set(ready_count)
+        if self.cluster is not None:
+            self.cluster.autoscale_tick()
         # stream stall watchdog + TTFT hedging
         with self._lock:
             tickets = list(self._tickets)
@@ -592,9 +652,12 @@ class Router:
                 for s in srcs:
                     self._migrate_stream(s, why="stalled")
                 continue
-            if (self.hedge_ms is not None and not t.hedged
-                    and t.t_first is None
+            if (self.hedge_ms is not None and self.cluster is None
+                    and not t.hedged and t.t_first is None
                     and (now - t.t_submit) * 1e3 > self.hedge_ms):
+                # hedging is fenced off in pool mode: the duplicate
+                # carries the FULL spec, which would re-mix prefill
+                # work into decode batches
                 self._hedge(t)
 
     def _hedge(self, ticket: RouterTicket):

@@ -236,6 +236,44 @@ class ApiServer:
                 "mode": TRACER.mode, "process": TRACER.process,
                 "capacity": TRACER.capacity,
                 "records": TRACER.snapshot()})
+        if method == "POST" and path == "/v1/kv":
+            # cluster KV handoff endpoint (ISSUE 20): the subprocess
+            # replica transport's ship/adopt surface. export captures
+            # the prompt's cached pages (engine thread, blocking — runs
+            # in the executor so the event loop keeps pumping streams);
+            # import digest-verifies and restores a shipped payload.
+            try:
+                payload = json.loads(body.decode() or "{}")
+            except (ValueError, UnicodeDecodeError):
+                return await self._send(writer, 400, _err(
+                    "invalid_json", "body is not valid JSON"))
+            loop = asyncio.get_running_loop()
+            try:
+                op = payload.get("op")
+                if op == "export":
+                    toks = payload.get("tokens") or []
+                    out = await loop.run_in_executor(
+                        None, self.frontend.export_kv, toks)
+                    from .replica import encode_kv_payload
+
+                    return await self._send(writer, 200, {
+                        "payload": (encode_kv_payload(out)
+                                    if out else None)})
+                if op == "import":
+                    from .replica import decode_kv_payload
+
+                    shipped = payload.get("payload") or {}
+                    adopted = await loop.run_in_executor(
+                        None, self.frontend.import_kv,
+                        decode_kv_payload(shipped) if shipped else {})
+                    return await self._send(writer, 200,
+                                            {"adopted": int(adopted)})
+                return await self._send(writer, 400, _err(
+                    "validation", "op must be 'export' or 'import'"))
+            except Exception as e:  # a failed handoff is a recompute
+                # on the caller's side, never a wedged endpoint
+                return await self._send(writer, 503, _err(
+                    "kv_handoff", f"{type(e).__name__}: {e}"))
         if method == "POST" and path in ("/v1/completions",
                                          "/v1/chat/completions"):
             try:

@@ -109,6 +109,26 @@ pages device→host and back):
   differential is the chaos suite's proof the runtime guard catches
   what the linter cannot.
 
+Cluster KV-handoff points (ISSUE 20 — consulted on the cluster
+coordinator's handoff thread; the stall lands at the start of the
+shipment, the corruption between the prefill replica's export and the
+decode replica's import):
+
+* ``kv-handoff-corrupt`` — flips one seed-chosen byte of one shipped
+  page's bytes while the payload is in transit between replicas, with
+  no doubt signal (a NIC/DMA flip). The decode-side per-page blake2b
+  verify in ``Engine.adopt_kv_pages`` must catch it and truncate the
+  adoption at the corrupt block; the stream falls back to
+  resume-from-emitted recompute for the unverified suffix — chaos
+  asserts the delivered tokens stay bit-identical.
+* ``kv-handoff-stall``   — sleeps ``delay_ms`` (default 50) at the top
+  of the handoff thread, simulating a slow source/transfer. A stall
+  past the cluster's ``handoff_budget_s`` abandons the shipment (the
+  decode placement proceeds as plain recompute); under budget it just
+  stretches the window — which is also how chaos holds the handoff
+  open to SIGKILL the prefill replica mid-shipment. Either way: no
+  deadlock, no stall of either engine thread, bit-identical stream.
+
 Spec grammar (``FLAGS_fault_inject`` / env ``PADDLE_TPU_FAULT_INJECT`` /
 ``Engine(fault_plan=...)``)::
 
@@ -173,6 +193,10 @@ POINTS = (
     # thread-ownership point (ISSUE 19 — consulted on the spill worker
     # thread; pairs with analysis.runtime.ownership_guard)
     "racey-worker-write",
+    # cluster KV-handoff points (ISSUE 20 — consulted on the cluster's
+    # handoff thread between the prefill export and the decode import)
+    "kv-handoff-corrupt",
+    "kv-handoff-stall",
 )
 
 

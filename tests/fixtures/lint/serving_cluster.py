@@ -1,0 +1,36 @@
+"""TPL1601 fixtures — cluster-layer code bypassing the replica surface
+(the path filter keys on 'serving' + 'cluster'/'router' in the
+filename, like serving_retry.py does for TPL902). The replica surface
+(ready/export_kv/import_kv/...) is the process boundary: an in-proc
+shortcut into `.engine`/`._fe` works right up until the replica is a
+subprocess worker, and it skips the engine-thread marshalling
+(ServingFrontend.call) besides."""
+from some_serving_lib.engine import Engine  # EXPECT: TPL1601
+
+
+def bad_direct_engine_build(model):
+    # replicas own their engines; the cluster layer asks a factory
+    return Engine(model, max_slots=2)  # EXPECT: TPL1601
+
+
+def bad_inproc_shortcut(rep, tokens):
+    # works in-proc, silently broken for a subprocess replica — and it
+    # calls into the engine from the wrong thread besides
+    return rep._fe.export_kv(tokens)  # EXPECT: TPL1601
+
+
+def bad_coordinator_reach_through(rep):
+    return rep.frontend.engine  # EXPECT: TPL1601 (x2)
+
+
+def good_replica_surface(rep, tokens, payload):
+    out = rep.export_kv(tokens)
+    adopted = rep.import_kv(payload)
+    return out, adopted, rep.ready().get("kv_chains")
+
+
+def good_suppressed_debug_probe(rep):
+    # a debugging hook that deliberately peers inside an in-proc
+    # replica, with the bypass acknowledged in place
+    # tpulint: disable=TPL1601 -- fixture: debug-only in-proc probe
+    return rep.frontend  # EXPECT-SUPPRESSED: TPL1601

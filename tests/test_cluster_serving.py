@@ -1,0 +1,494 @@
+"""Cluster-scale serving suite (ISSUE 20) — wired into ``make chaos``.
+
+Layers covered:
+
+* **pool placement units** — ``parse_pools``, the prefill budget cap
+  (``ClusterCoordinator.outbound``), role filtering in ``Router._pick``,
+  and prefix-overlap scoring (``choose``) — all on stub replicas, no
+  engines;
+* **handoff payload round-trip** — the replica-transport codec
+  (``encode_kv_payload``/``decode_kv_payload``) is byte-exact, dtypes
+  included;
+* **pooled serving end-to-end** (slow-marked, chaos-enforced) — a
+  prefill+decode fleet serves bit-identically to a single unpooled
+  engine, ships KV exactly once, and survives ``kv-handoff-corrupt``,
+  ``kv-handoff-stall``, and a prefill replica killed mid-handoff by
+  degrading to resume-from-emitted recompute — zero failed requests,
+  identical tokens;
+* **mixed-version routing** — a replica whose readiness payload
+  predates the ``kv_chains``/``page_size`` fields still routes
+  (availability-only placement; handoff degrades to recompute);
+* **autoscale lifecycle** — queue-depth driven role reassignment,
+  factory spawn, and idle drain, each observable in
+  ``paddle_tpu_cluster_rebalances_total`` and the pool gauges.
+"""
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference.engine import Engine
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.observability import metric_total
+from paddle_tpu.serving import (InProcReplica, Replica, Router,
+                                ServingFrontend, StreamSpec, parse_pools)
+from paddle_tpu.serving.replica import (decode_kv_payload,
+                                        encode_kv_payload)
+from paddle_tpu.serving.router import RouterTicket
+
+VOCAB = 97
+PROMPT = list(range(1, 21))
+
+
+@pytest.fixture(scope="module")
+def gpt():
+    paddle.seed(0)
+    cfg = GPTConfig(hidden_size=64, num_layers=2, num_heads=2,
+                    max_position=128, vocab_size=VOCAB)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    return model
+
+
+def _factory(gpt):
+    def factory():
+        eng = Engine(gpt, max_slots=2, num_pages=64, page_size=8,
+                     chunk_size=4, dtype=jnp.float32, prefix_cache=True)
+        return ServingFrontend(eng)
+    return factory
+
+
+@pytest.fixture(scope="module")
+def reference(gpt):
+    """Unpooled greedy tokens for PROMPT — what every pooled/degraded
+    variant below must reproduce byte-for-byte."""
+    eng = Engine(gpt, max_slots=2, num_pages=64, page_size=8,
+                 chunk_size=4, dtype=jnp.float32)
+    req = eng.add_request(np.asarray(PROMPT, np.int32), 16)
+    eng.run()
+    assert req.done and not req.failed
+    return list(req.tokens)
+
+
+class StubReplica(Replica):
+    """Replica surface stand-in for placement/autoscale units: health
+    and load are plain attributes, no engine anywhere."""
+
+    def __init__(self, name, index, load=0, payload=None):
+        super().__init__(name, index)
+        self._alive = True
+        self._load = int(load)
+        self.payload = dict(payload or {})
+        self.stopped = False
+
+    def alive(self):
+        return self._alive
+
+    def ready(self):
+        out = {"ready": self._alive, "queue_depth": 0}
+        out.update(self.payload)
+        return out
+
+    @property
+    def inflight(self):
+        return self._load
+
+    def start(self):
+        self._alive = True
+
+    def stop(self):
+        self.stopped = True
+        self._alive = False
+
+    def kill(self):
+        self._alive = False
+
+
+def _stub_router(n=3, pools=None, **kw):
+    reps = [StubReplica(f"s{i}", i) for i in range(n)]
+    router = Router(reps, pools=pools or {"prefill": 1, "decode": n - 1},
+                    **kw)
+    return router, reps  # never started: no monitor thread to clean up
+
+
+def _wait(pred, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ------------------------------------------------------- placement units
+class TestPoolPlacement:
+    def test_parse_pools(self):
+        assert parse_pools("prefill=1,decode=2") == {"prefill": 1,
+                                                     "decode": 2}
+        assert parse_pools("decode=4") == {"decode": 4}
+        for bad in ("", "prefill=x", "draw=2", "prefill"):
+            with pytest.raises(ValueError):
+                parse_pools(bad)
+
+    def test_roles_assigned_in_order_with_decode_overflow(self):
+        router, reps = _stub_router(4, pools={"prefill": 1, "decode": 2})
+        cl = router.cluster
+        assert [cl.role_of(r) for r in reps] == [
+            "prefill", "decode", "decode", "decode"]
+        assert cl.pool_sizes() == {"prefill": 1, "decode": 3}
+
+    def test_outbound_caps_prefill_leg_to_one_token(self):
+        router, reps = _stub_router(3)
+        cl = router.cluster
+        cl.observe(reps[0], {"page_size": 8})
+        spec = StreamSpec(PROMPT, 16)
+        ticket = RouterTicket(spec)
+        sub, role = cl.outbound(ticket, spec)
+        assert role == "prefill" and ticket.phase == "prefill"
+        assert sub.max_new_tokens == 1
+        assert sub.prompt == spec.prompt
+        # the original spec keeps the full budget for the decode leg
+        assert ticket.spec.max_new_tokens == 16
+
+    def test_outbound_skips_disaggregation_when_not_worth_it(self):
+        router, reps = _stub_router(3)
+        cl = router.cluster
+        cl.observe(reps[0], {"page_size": 8})
+        # budget 1: the prefill leg IS the request
+        t1 = RouterTicket(StreamSpec(PROMPT, 1))
+        sub, role = cl.outbound(t1, t1.spec)
+        assert role == "decode" and sub.max_new_tokens == 1
+        # prompt under one page: nothing cacheable to ship
+        t2 = RouterTicket(StreamSpec([1, 2, 3], 16))
+        sub, role = cl.outbound(t2, t2.spec)
+        assert role == "decode" and sub.max_new_tokens == 16
+        # resumed placement (continuation/migration): decode pool
+        t3 = RouterTicket(StreamSpec(PROMPT, 16))
+        resumed = StreamSpec(PROMPT, 16, resume_tokens=[5])
+        sub, role = cl.outbound(t3, resumed)
+        assert role == "decode" and t3.phase == "decode"
+
+    def test_pick_filters_by_role_and_borrows_when_pool_empty(self):
+        router, reps = _stub_router(3)
+        assert router._pick(role="prefill") is reps[0]
+        assert router._pick(role="decode") in reps[1:]
+        # dead pool borrows cross-role: availability beats purity
+        reps[0].kill()
+        assert router._pick(role="prefill") in reps[1:]
+
+    def test_cache_aware_placement_beats_least_loaded(self):
+        router, reps = _stub_router(3)
+        cl = router.cluster
+        cl.observe(reps[1], {"page_size": 8})
+        keys = cl.prompt_keys(PROMPT)
+        assert len(keys) == len(PROMPT) // 8
+        # replica 2 holds the prompt's chain but carries MORE load;
+        # overlap depth outranks load
+        reps[1]._load, reps[2]._load = 0, 1
+        cl.observe(reps[2], {"kv_chains": keys})
+        spec = StreamSpec(PROMPT, 16)
+        assert cl.choose([reps[1], reps[2]], spec) is reps[2]
+        # no overlap anywhere -> degenerates to least-loaded
+        other = StreamSpec(list(range(40, 60)), 16)
+        assert cl.choose([reps[1], reps[2]], other) is reps[1]
+        # partial overlap loses to deeper overlap
+        cl.observe(reps[1], {"kv_chains": keys[:1]})
+        assert cl.choose([reps[1], reps[2]], spec) is reps[2]
+
+    def test_mixed_version_readiness_routes_availability_only(self):
+        """Satellite 6: an older replica's readiness payload has no
+        ``kv_chains``/``page_size``/``eos_id`` — observe() must not
+        blow up, its view stays empty, and placement degrades to the
+        PR 13 least-loaded pick."""
+        router, reps = _stub_router(3)
+        cl = router.cluster
+        cl.observe(reps[1], {"ready": True, "queue_depth": 0})  # old
+        cl.observe(reps[2], {"ready": True})                    # old
+        assert cl._page_size is None
+        assert cl.prompt_keys(PROMPT) == []  # no geometry -> no scoring
+        reps[1]._load, reps[2]._load = 2, 1
+        spec = StreamSpec(PROMPT, 16)
+        assert cl.choose([reps[1], reps[2]], spec) is reps[2]
+        # and a mixed fleet: one new replica reporting geometry+chains
+        # wins for its prefix, the old ones still place by load
+        cl.observe(reps[1], {"page_size": 8})
+        cl.observe(reps[1], {"kv_chains": cl.prompt_keys(PROMPT)})
+        assert cl.choose([reps[1], reps[2]], spec) is reps[1]
+
+
+# --------------------------------------------------- payload codec units
+class TestHandoffCodec:
+    def test_round_trip_is_byte_exact(self):
+        rng = np.random.default_rng(0)
+        payload = {
+            "tokens": PROMPT[:16], "page_size": 8, "nbytes": 128,
+            "digests": ["aa", "bb"], "dev_sums": [1.5, None],
+            "pages": [
+                [rng.standard_normal((2, 8, 4)).astype(np.float32),
+                 (rng.integers(-128, 127, (2, 8, 4))
+                  .astype(np.int8))],
+                [rng.standard_normal((2, 8, 4)).astype(np.float32),
+                 (rng.integers(-128, 127, (2, 8, 4))
+                  .astype(np.int8))],
+            ],
+        }
+        wire = encode_kv_payload(payload)
+        back = decode_kv_payload(wire)
+        assert back["tokens"] == payload["tokens"]
+        assert back["digests"] == payload["digests"]
+        assert back["dev_sums"] == payload["dev_sums"]
+        for brow, prow in zip(back["pages"], payload["pages"]):
+            for b, p in zip(brow, prow):
+                assert b.dtype == p.dtype and b.shape == p.shape
+                assert b.tobytes() == p.tobytes()
+
+    def test_bf16_rows_survive_the_wire(self):
+        import ml_dtypes
+        row = np.arange(16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        payload = {"tokens": [1], "page_size": 8, "digests": ["x"],
+                   "dev_sums": [None], "pages": [[row]]}
+        back = decode_kv_payload(encode_kv_payload(payload))
+        assert back["pages"][0][0].dtype == row.dtype
+        assert back["pages"][0][0].tobytes() == row.tobytes()
+
+
+# ------------------------------------------------- pooled serving (slow)
+def _pooled_router(gpt, n=3, **kw):
+    reps = [InProcReplica(_factory(gpt), name=f"c{i}", index=i)
+            for i in range(n)]
+    kw.setdefault("heartbeat_s", 0.05)
+    kw.setdefault("stall_s", None)
+    kw.setdefault("pools", {"prefill": 1, "decode": n - 1})
+    return Router(reps, **kw), reps
+
+
+class TestPooledServing:
+    @pytest.mark.slow  # chaos-enforced; 3 engine builds
+    def test_handoff_round_trip_is_bit_identical(self, gpt, reference):
+        """The acceptance core: prefill on one pool, decode on the
+        other, KV shipped once and digest-verified — the client sees
+        the single-engine token sequence exactly."""
+        router, reps = _pooled_router(gpt)
+        router.start()
+        try:
+            assert _wait(lambda: router.cluster._page_size is not None)
+            h0 = metric_total("paddle_tpu_cluster_handoffs_total")
+            b0 = metric_total("paddle_tpu_cluster_handoff_bytes_total")
+            f0 = metric_total("paddle_tpu_cluster_fallbacks_total")
+            fails0 = metric_total("paddle_tpu_request_failures_total")
+            chunks = []
+            t = router.submit(PROMPT, 16,
+                              on_chunk=lambda c: chunks.append(c))
+            out = t.result(timeout=180)
+            assert out == reference
+            assert t.failure_reason is None and t.phase == "decode"
+            # the spliced callback stream carries no duplicates/gaps
+            flat = [tok for c in chunks if c for tok in c]
+            assert flat == reference and chunks[-1] is None
+            assert metric_total(
+                "paddle_tpu_cluster_handoffs_total") == h0 + 1
+            assert metric_total(
+                "paddle_tpu_cluster_handoff_bytes_total") > b0
+            assert metric_total(
+                "paddle_tpu_cluster_fallbacks_total") == f0
+            assert metric_total(
+                "paddle_tpu_request_failures_total") == fails0
+            # a second shared-prefix stream rides the warmed decode
+            # replica: bit-identical again, and cache-aware placement
+            # keeps it on the pool that holds the chain
+            t2 = router.submit(PROMPT, 16)
+            assert t2.result(timeout=180) == reference
+            assert t2.replica in [r.name for r in reps[1:]]
+        finally:
+            router.shutdown()
+
+    @pytest.mark.slow  # chaos-enforced
+    def test_corrupt_handoff_falls_back_bit_identically(self, gpt,
+                                                        reference):
+        """``kv-handoff-corrupt``: one shipped byte flipped in transit.
+        The decode-side digest verify truncates the adoption; whatever
+        was not verified is recomputed — tokens identical, zero
+        failures, the degradation visible in the fallback counter."""
+        router, _ = _pooled_router(
+            gpt, fault_plan="kv-handoff-corrupt:every=1")
+        router.start()
+        try:
+            assert _wait(lambda: router.cluster._page_size is not None)
+            h0 = metric_total("paddle_tpu_cluster_handoffs_total")
+            f0 = metric_total("paddle_tpu_cluster_fallbacks_total")
+            fails0 = metric_total("paddle_tpu_request_failures_total")
+            t = router.submit(PROMPT, 16)
+            assert t.result(timeout=180) == reference
+            assert t.failure_reason is None
+            # the flip either voided the whole shipment (fallback) or
+            # truncated it to a verified prefix (counted handoff) —
+            # never a silently-wrong splice
+            dh = metric_total("paddle_tpu_cluster_handoffs_total") - h0
+            df = metric_total("paddle_tpu_cluster_fallbacks_total") - f0
+            assert dh + df == 1
+            assert metric_total(
+                "paddle_tpu_request_failures_total") == fails0
+        finally:
+            router.shutdown()
+
+    @pytest.mark.slow  # chaos-enforced
+    def test_stalled_handoff_degrades_without_deadlock(self, gpt,
+                                                       reference):
+        """``kv-handoff-stall`` past ``handoff_budget_s``: the shipment
+        is abandoned, the decode leg recomputes, nothing blocks."""
+        router, _ = _pooled_router(
+            gpt, fault_plan="kv-handoff-stall:every=1,delay_ms=300",
+            handoff_budget_s=0.05)
+        router.start()
+        try:
+            assert _wait(lambda: router.cluster._page_size is not None)
+            h0 = metric_total("paddle_tpu_cluster_handoffs_total")
+            f0 = metric_total("paddle_tpu_cluster_fallbacks_total")
+            t = router.submit(PROMPT, 16)
+            assert t.result(timeout=180) == reference
+            assert t.failure_reason is None
+            assert metric_total(
+                "paddle_tpu_cluster_fallbacks_total") == f0 + 1
+            assert metric_total(
+                "paddle_tpu_cluster_handoffs_total") == h0
+        finally:
+            router.shutdown()
+
+    @pytest.mark.slow  # chaos-enforced
+    def test_prefill_killed_mid_handoff_recomputes(self, gpt,
+                                                   reference):
+        """The chaos gate: SIGKILL the prefill replica while the
+        handoff is in flight (the stall fault holds the shipment open).
+        Export fails against the corpse, the decode replica recomputes
+        from the one emitted token, and the client stream is
+        bit-identical with zero failures."""
+        router, reps = _pooled_router(
+            gpt, fault_plan="kv-handoff-stall:every=1,delay_ms=500",
+            handoff_budget_s=30.0, restart_backoff_s=0.05)
+        router.start()
+        try:
+            assert _wait(lambda: router.cluster._page_size is not None)
+            f0 = metric_total("paddle_tpu_cluster_fallbacks_total")
+            fails0 = metric_total("paddle_tpu_request_failures_total")
+            t = router.submit(PROMPT, 16)
+            # the handoff phase begins the moment the prefill leg's
+            # single token lands; the 500 ms stall keeps it open
+            assert _wait(lambda: t.phase == "handoff"), t.phase
+            victim = next(r for r in reps
+                          if router.cluster.role_of(r) == "prefill")
+            victim.kill()
+            out = t.result(timeout=180)
+            assert out == reference
+            assert t.failure_reason is None
+            assert metric_total(
+                "paddle_tpu_cluster_fallbacks_total") == f0 + 1
+            assert metric_total(
+                "paddle_tpu_request_failures_total") == fails0
+        finally:
+            router.shutdown()
+
+    @pytest.mark.slow  # chaos-enforced
+    def test_mixed_version_fleet_still_serves(self, gpt, reference):
+        """Satellite 6, end-to-end: the decode replica predates the
+        KV-handoff surface (no ``kv_chains`` in readiness, import is a
+        no-op). Routing is availability-only, the handoff degrades to
+        recompute, the stream is bit-identical."""
+        class OldReplica(InProcReplica):
+            def ready(self):
+                out = super().ready()
+                for k in ("kv_chains", "page_size", "eos_id"):
+                    out.pop(k, None)
+                return out
+
+            def export_kv(self, tokens):
+                return None
+
+            def import_kv(self, payload):
+                return 0
+
+        reps = [InProcReplica(_factory(gpt), name="new0", index=0),
+                OldReplica(_factory(gpt), name="old1", index=1)]
+        router = Router(reps, heartbeat_s=0.05, stall_s=None,
+                        pools={"prefill": 1, "decode": 1})
+        router.start()
+        try:
+            f0 = metric_total("paddle_tpu_cluster_fallbacks_total")
+            fails0 = metric_total("paddle_tpu_request_failures_total")
+            t = router.submit(PROMPT, 16)
+            assert t.result(timeout=180) == reference
+            assert t.failure_reason is None
+            assert t.replica == "old1"  # the decode pool IS the old one
+            assert metric_total(
+                "paddle_tpu_cluster_fallbacks_total") == f0 + 1
+            assert metric_total(
+                "paddle_tpu_request_failures_total") == fails0
+        finally:
+            router.shutdown()
+
+
+# ---------------------------------------------------- autoscale (units)
+class TestAutoscale:
+    def test_reassigns_idle_donor_to_starved_pool(self):
+        router, reps = _stub_router(3, heartbeat_s=10.0)
+        cl = router.cluster
+        r0 = metric_total("paddle_tpu_cluster_rebalances_total")
+        reps[0].payload["queue_depth"] = 20       # prefill starved
+        cl.observe(reps[1], {"inflight": 0})      # idle decode donor
+        cl.observe(reps[2], {"inflight": 0})
+        cl.autoscale_tick()
+        assert cl.pool_sizes() == {"prefill": 2, "decode": 1}
+        assert metric_total(
+            "paddle_tpu_cluster_rebalances_total") == r0 + 1
+        # decode is now AT min_per_role: a second tick must not strip it
+        cl.autoscale_tick()
+        assert cl.pool_sizes()["decode"] == 1
+
+    def test_spawns_through_factory_when_both_pools_backlogged(self):
+        spawned = []
+
+        def factory():
+            rep = StubReplica(f"x{len(spawned)}", 90 + len(spawned))
+            spawned.append(rep)
+            return rep
+
+        reps = [StubReplica(f"s{i}", i,
+                            payload={"queue_depth": 20})
+                for i in range(2)]
+        router = Router(reps, pools={"prefill": 1, "decode": 1},
+                        replica_factory=factory,
+                        autoscale={"queue_high": 4, "max_replicas": 3})
+        cl = router.cluster
+        r0 = metric_total("paddle_tpu_cluster_rebalances_total")
+        cl.autoscale_tick()
+        assert len(spawned) == 1 and len(router.replicas) == 3
+        assert sum(cl.pool_sizes().values()) == 3
+        assert metric_total(
+            "paddle_tpu_cluster_rebalances_total") == r0 + 1
+        # at max_replicas: no further growth
+        cl.autoscale_tick()
+        assert len(spawned) == 1
+
+    def test_drains_surplus_idle_replica_and_supervisor_skips_it(self):
+        router, reps = _stub_router(3, heartbeat_s=10.0)
+        cl = router.cluster
+        cl.autoscale_tick()
+        assert not any(r.stopped for r in reps)  # no idle clock yet
+        for r in reps[1:]:
+            cl.observe(r, {"inflight": 0})
+        cl.idle_grace_s = 0.0
+        r0 = metric_total("paddle_tpu_cluster_rebalances_total")
+        cl.autoscale_tick()
+        assert cl.pool_sizes() == {"prefill": 1, "decode": 1}
+        drained = [r for r in reps if r.stopped]
+        assert len(drained) == 1
+        assert metric_total(
+            "paddle_tpu_cluster_rebalances_total") == r0 + 1
+        # routing and the supervisor both skip the drained replica
+        assert router._pick(role="decode") is not drained[0]
+        idx = reps.index(drained[0])
+        assert cl.is_drained(idx) and cl.role_of(drained[0]) is None
+        # min_per_role floors the shrink
+        cl.autoscale_tick()
+        assert cl.pool_sizes() == {"prefill": 1, "decode": 1}

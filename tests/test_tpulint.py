@@ -101,7 +101,7 @@ class TestRegistry:
             "TPL304", "TPL401", "TPL402", "TPL501", "TPL502", "TPL503",
             "TPL601", "TPL701", "TPL702", "TPL801", "TPL901", "TPL902",
             "TPL1002", "TPL1101", "TPL1201", "TPL1301", "TPL1401",
-            "TPL1501", "TPL1502", "TPL1503", "TPL1504",
+            "TPL1501", "TPL1502", "TPL1503", "TPL1504", "TPL1601",
         }
         for r in RULES.values():
             assert r.description and r.name and r.family
